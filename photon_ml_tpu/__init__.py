@@ -1,0 +1,26 @@
+"""photon_ml_tpu — a TPU-native framework for large-scale GLMs and GAME
+(Generalized Additive Mixed Effect) models.
+
+Re-designed from scratch for TPU hardware (JAX / XLA / pjit / shard_map):
+
+- Objective functions are pure ``jnp`` programs; value+gradient come from
+  ``jax.value_and_grad`` and Hessian-vector products from ``jax.jvp`` of the
+  gradient, letting XLA fuse what the reference implemented as hand-written
+  single-pass aggregators (reference:
+  photon-ml/src/main/scala/com/linkedin/photon/ml/function/ValueAndGradientAggregator.scala).
+- Optimizers (L-BFGS / OWL-QN / TRON) are ``lax.while_loop`` state machines
+  that run in three modes: distributed (data sharded over a mesh, gradients
+  all-reduced by XLA), batched (``vmap`` over an entity axis for random
+  effects), and local (single device).
+- The GAME coordinate-descent algorithm keeps scores as dense device-resident
+  vectors indexed by row id — the reference's RDD join choreography
+  (KeyValueScore) becomes pure elementwise arithmetic.
+
+Capability parity target: Harikiranvuyyuru/photon-ml (LinkedIn Photon-ML).
+"""
+
+from photon_ml_tpu.types import TaskType
+
+__version__ = "0.1.0"
+
+__all__ = ["TaskType", "__version__"]

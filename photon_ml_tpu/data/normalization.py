@@ -1,0 +1,131 @@
+"""Feature normalization as algebra, never materialized on the data.
+
+The reference's key trick (ml/normalization/NormalizationContext.scala:38-83,
+folded into the aggregators at ml/function/ValueAndGradientAggregator.scala:34-221):
+train in the normalized feature space x' = (x - shift) .* factor WITHOUT
+rewriting the data, by operating on effective coefficients
+``eff = coef .* factor`` and a margin shift ``-eff . shift``. We keep exactly
+that algebra — on TPU it additionally avoids materializing a second copy of
+the batch in HBM and keeps CSR sparsity intact.
+
+Model back-transform to the original space:
+  w = w' .* factor,  b' -= w . shift  (intercept absorbs the shift).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """factors/shifts over the feature axis; intercept excluded from both.
+
+    factors: multiplicative scale per feature (None = all ones).
+    shifts: additive shift per feature (None = all zeros).
+    intercept_id: index of the intercept column, or -1 if none. The intercept
+      column must have factor 1 and shift 0 (it is appended by ingest as a
+      constant-1 feature).
+    """
+
+    factors: Optional[Array]
+    shifts: Optional[Array]
+    intercept_id: int = -1
+
+    def effective_coefficients(self, coef: Array) -> Array:
+        return coef * self.factors if self.factors is not None else coef
+
+    def margin_shift(self, coef: Array) -> Array:
+        if self.shifts is None:
+            return jnp.zeros((), dtype=coef.dtype)
+        eff = self.effective_coefficients(coef)
+        return -(eff @ self.shifts)
+
+    def model_to_original_space(self, coef: Array) -> Array:
+        """Transform coefficients trained in normalized space back to raw space."""
+        out = self.effective_coefficients(coef)
+        if self.shifts is not None:
+            if self.intercept_id < 0:
+                raise ValueError(
+                    "Normalization with shifts requires an intercept column"
+                )
+            out = out.at[self.intercept_id].add(-(out @ self.shifts))
+        return out
+
+    def model_to_normalized_space(self, coef: Array) -> Array:
+        """Inverse of model_to_original_space (for warm starts across spaces)."""
+        out = coef
+        if self.shifts is not None:
+            if self.intercept_id < 0:
+                raise ValueError(
+                    "Normalization with shifts requires an intercept column"
+                )
+            out = out.at[self.intercept_id].add(out @ self.shifts)
+        if self.factors is not None:
+            out = out / self.factors
+        return out
+
+    def tree_flatten(self):
+        return (self.factors, self.shifts), (self.intercept_id,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+
+def no_normalization() -> NormalizationContext:
+    return NormalizationContext(factors=None, shifts=None, intercept_id=-1)
+
+
+def build_normalization_context(
+    norm_type: str,
+    summary,
+    intercept_id: int = -1,
+) -> NormalizationContext:
+    """Build from a BasicStatisticalSummary.
+
+    Reference: ml/normalization/NormalizationContext.scala factory — the four
+    flavors of ml/normalization/NormalizationType.java:25-40.
+    """
+    from photon_ml_tpu.types import NormalizationType
+
+    nt = NormalizationType(norm_type)
+    if nt == NormalizationType.NONE:
+        return NormalizationContext(None, None, intercept_id)
+
+    std = np.asarray(summary.variance) ** 0.5
+    safe_std = np.where(std > 0, std, 1.0)
+    max_mag = np.maximum(np.abs(np.asarray(summary.max)),
+                         np.abs(np.asarray(summary.min)))
+    safe_mag = np.where(max_mag > 0, max_mag, 1.0)
+
+    factors = None
+    shifts = None
+    if nt == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors = 1.0 / safe_std
+    elif nt == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors = 1.0 / safe_mag
+    elif nt == NormalizationType.STANDARDIZATION:
+        factors = 1.0 / safe_std
+        shifts = np.asarray(summary.mean).copy()
+    if nt == NormalizationType.STANDARDIZATION and intercept_id < 0:
+        raise ValueError("STANDARDIZATION requires an intercept column")
+
+    # The intercept column stays untouched.
+    if intercept_id >= 0:
+        if factors is not None:
+            factors = np.asarray(factors).copy()
+            factors[intercept_id] = 1.0
+        if shifts is not None:
+            shifts[intercept_id] = 0.0
+
+    to_arr = lambda a: None if a is None else jnp.asarray(a, dtype=jnp.float32)
+    return NormalizationContext(to_arr(factors), to_arr(shifts), intercept_id)

@@ -1,0 +1,25 @@
+"""TPU compute kernels: pointwise losses, feature ops, GLM objectives."""
+
+from photon_ml_tpu.ops.losses import (
+    PointwiseLoss,
+    LogisticLoss,
+    SquaredLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    loss_for_task,
+)
+from photon_ml_tpu.ops.features import FeatureMatrix, DenseFeatures, CSRFeatures
+from photon_ml_tpu.ops.glm_objective import GLMObjective
+
+__all__ = [
+    "PointwiseLoss",
+    "LogisticLoss",
+    "SquaredLoss",
+    "PoissonLoss",
+    "SmoothedHingeLoss",
+    "loss_for_task",
+    "FeatureMatrix",
+    "DenseFeatures",
+    "CSRFeatures",
+    "GLMObjective",
+]

@@ -1,0 +1,160 @@
+"""Device-resident feature matrix representations.
+
+The reference keeps features as per-row Breeze sparse vectors inside RDDs
+(ml/data/LabeledPoint.scala). On TPU the analogous choice is struct-of-arrays
+in HBM, in one of two layouts:
+
+- ``DenseFeatures``: padded dense ``f32[n, d]`` — the right layout whenever d
+  is modest (per-entity blocks after feature selection, tutorial datasets).
+  Margins are a single MXU matmul.
+- ``CSRFeatures``: flat ``values/col_ids/row_ids`` triplet (COO-sorted-by-row,
+  i.e. expanded CSR) padded to a static nnz — the layout for very wide sparse
+  fixed-effect problems. Margins are a segment-sum; the transpose product is a
+  scatter-add. Both are static-shape and jit/vmap-safe.
+
+Both are registered pytrees, so they flow through ``jit``/``vmap``/``pjit``
+and can be sharded with ``NamedSharding`` like any other array.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class DenseFeatures:
+    """Dense feature matrix x: [n_rows, n_features]."""
+
+    x: Array
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.x.shape
+
+    @property
+    def num_features(self) -> int:
+        return self.x.shape[-1]
+
+    def matvec(self, v: Array) -> Array:
+        """x @ v -> [n_rows]. v may have a leading batch dim under vmap."""
+        return self.x @ v
+
+    def rmatvec(self, u: Array) -> Array:
+        """x.T @ u -> [n_features]."""
+        return u @ self.x
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        """(x*x) @ v — used for Hessian-diagonal aggregation."""
+        return (self.x * self.x) @ v
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        """(x*x).T @ u -> [n_features] — per-feature weighted square sums."""
+        return u @ (self.x * self.x)
+
+    def tree_flatten(self):
+        return (self.x,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class CSRFeatures:
+    """Sparse feature matrix in expanded-CSR (row-sorted COO) layout.
+
+    values[k] at (row_ids[k], col_ids[k]); padded entries carry value 0 and
+    point at row 0 / col 0, so they contribute nothing to any product.
+
+    n_rows / n_features are static Python ints (aux data) — they fix the
+    output shapes for XLA.
+    """
+
+    values: Array  # f[nnz]
+    col_ids: Array  # i32[nnz]
+    row_ids: Array  # i32[nnz]
+    n_rows: int
+    n_features: int
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.n_features)
+
+    @property
+    def num_features(self) -> int:
+        return self.n_features
+
+    def matvec(self, v: Array) -> Array:
+        contrib = self.values * v[self.col_ids]
+        return jax.ops.segment_sum(contrib, self.row_ids, num_segments=self.n_rows)
+
+    def rmatvec(self, u: Array) -> Array:
+        contrib = self.values * u[self.row_ids]
+        return jax.ops.segment_sum(
+            contrib, self.col_ids, num_segments=self.n_features
+        )
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        sq = self.values * self.values
+        contrib = sq * v[self.col_ids]
+        return jax.ops.segment_sum(contrib, self.row_ids, num_segments=self.n_rows)
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        sq = self.values * self.values
+        contrib = sq * u[self.row_ids]
+        return jax.ops.segment_sum(
+            contrib, self.col_ids, num_segments=self.n_features
+        )
+
+    def to_dense(self) -> DenseFeatures:
+        x = jnp.zeros((self.n_rows, self.n_features), dtype=self.values.dtype)
+        x = x.at[self.row_ids, self.col_ids].add(self.values)
+        return DenseFeatures(x)
+
+    def tree_flatten(self):
+        return (self.values, self.col_ids, self.row_ids), (
+            self.n_rows,
+            self.n_features,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+FeatureMatrix = Union[DenseFeatures, CSRFeatures]
+
+
+def csr_from_scipy(mat, n_features: int | None = None, pad_to: int | None = None,
+                   dtype=jnp.float32) -> CSRFeatures:
+    """Build CSRFeatures from a scipy.sparse matrix (host-side ingest)."""
+    coo = mat.tocoo()
+    order = np.argsort(coo.row, kind="stable")
+    rows = coo.row[order].astype(np.int32)
+    cols = coo.col[order].astype(np.int32)
+    vals = coo.data[order]
+    nnz = len(vals)
+    target = pad_to if pad_to is not None else nnz
+    if target < nnz:
+        raise ValueError(f"pad_to={target} < nnz={nnz}")
+    pad = target - nnz
+    if pad:
+        rows = np.concatenate([rows, np.zeros(pad, np.int32)])
+        cols = np.concatenate([cols, np.zeros(pad, np.int32)])
+        vals = np.concatenate([vals, np.zeros(pad, vals.dtype)])
+    return CSRFeatures(
+        values=jnp.asarray(vals, dtype=dtype),
+        col_ids=jnp.asarray(cols),
+        row_ids=jnp.asarray(rows),
+        n_rows=int(mat.shape[0]),
+        n_features=int(n_features if n_features is not None else mat.shape[1]),
+    )

@@ -1,0 +1,43 @@
+"""Test harness: emulate an 8-device TPU mesh on CPU.
+
+The analog of the reference's Spark `local[4]` integration harness
+(photon-test-utils/.../SparkTestUtils.scala:191): the same sharding /
+collective code paths run on 8 virtual CPU devices, so multi-chip logic is
+exercised without TPU hardware. Must run before jax initializes — hence the
+env mutation at import time of this conftest.
+
+f64 is enabled so golden-value tests can run at Breeze-like precision; device
+code paths stay dtype-polymorphic and run f32/bf16 on real TPU.
+"""
+
+import os
+
+# Force CPU for tests even when the session exposes a TPU (JAX_PLATFORMS=axon):
+# unit/integration tiers need f64 and 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# Plugins (flax/chex) may have imported jax before this conftest ran, in which
+# case the env vars above were read too late — re-apply through jax.config
+# (safe while the backend is uninitialized).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+assert jax.device_count() == 8, (
+    f"test harness expected 8 virtual CPU devices, got {jax.device_count()}"
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(20260729)

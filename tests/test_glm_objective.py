@@ -1,0 +1,175 @@
+"""GLM objective: AD-derived grad/Hv/Hdiag vs explicit dense formulas,
+normalization algebra vs materialized normalization, CSR vs dense parity.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import scipy.sparse as sp
+
+from photon_ml_tpu.ops.features import DenseFeatures, csr_from_scipy
+from photon_ml_tpu.ops.glm_objective import GLMObjective, make_batch
+from photon_ml_tpu.ops.losses import LogisticLoss, PoissonLoss
+from photon_ml_tpu.data.normalization import NormalizationContext
+
+
+def _problem(rng, n=40, d=7):
+    x = rng.normal(0, 1, (n, d))
+    x[:, -1] = 1.0  # intercept column
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    w = rng.random(n) + 0.5
+    off = rng.normal(0, 0.1, n)
+    coef = rng.normal(0, 0.5, d)
+    return x, y, w, off, coef
+
+
+def test_value_and_grad_match_explicit_formula(rng):
+    x, y, w, off, coef = _problem(rng)
+    obj = GLMObjective(LogisticLoss)
+    batch = make_batch(DenseFeatures(jnp.asarray(x)), y, off, w)
+    l2 = 0.3
+    val, grad = obj.value_and_grad(jnp.asarray(coef), batch, l2)
+
+    z = x @ coef + off
+    lo = np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - y * z
+    exp_val = np.sum(w * lo) + 0.5 * l2 * coef @ coef
+    dz = 1 / (1 + np.exp(-z)) - y
+    exp_grad = x.T @ (w * dz) + l2 * coef
+    np.testing.assert_allclose(float(val), exp_val, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(grad), exp_grad, rtol=1e-9)
+
+
+def test_hessian_vector_and_diagonal_match_dense_hessian(rng):
+    x, y, w, off, coef = _problem(rng, n=30, d=5)
+    obj = GLMObjective(LogisticLoss)
+    batch = make_batch(DenseFeatures(jnp.asarray(x)), y, off, w)
+    l2 = 0.1
+    z = x @ coef + off
+    s = 1 / (1 + np.exp(-z))
+    d2 = w * s * (1 - s)
+    H = x.T @ (x * d2[:, None]) + l2 * np.eye(5)
+
+    v = np.linspace(-1, 1, 5)
+    hv = obj.hessian_vector(jnp.asarray(coef), jnp.asarray(v), batch, l2)
+    np.testing.assert_allclose(np.asarray(hv), H @ v, rtol=1e-9)
+
+    hd = obj.hessian_diagonal(jnp.asarray(coef), batch, l2)
+    np.testing.assert_allclose(np.asarray(hd), np.diag(H), rtol=1e-9)
+
+    var = obj.coefficient_variances(jnp.asarray(coef), batch, l2)
+    np.testing.assert_allclose(np.asarray(var), 1 / (np.diag(H) + 1e-12),
+                               rtol=1e-9)
+
+
+def test_normalization_algebra_equals_materialized(rng):
+    """Training-space objective via factors/shifts == objective on explicitly
+    normalized features (the reference's sparsity-preserving trick,
+    ml/normalization/NormalizationContext.scala:38-83)."""
+    x, y, w, off, coef = _problem(rng)
+    d = x.shape[1]
+    mean = x.mean(axis=0)
+    std = x.std(axis=0) + 0.1
+    factors = 1 / std
+    shifts = mean.copy()
+    factors[-1], shifts[-1] = 1.0, 0.0  # intercept untouched
+
+    norm = NormalizationContext(jnp.asarray(factors), jnp.asarray(shifts),
+                                intercept_id=d - 1)
+    obj_norm = GLMObjective(LogisticLoss, norm)
+    batch_raw = make_batch(DenseFeatures(jnp.asarray(x)), y, off, w)
+
+    x_mat = (x - shifts) * factors
+    obj_plain = GLMObjective(LogisticLoss)
+    batch_mat = make_batch(DenseFeatures(jnp.asarray(x_mat)), y, off, w)
+
+    c = jnp.asarray(coef)
+    v1, g1 = obj_norm.value_and_grad(c, batch_raw, 0.2)
+    v2, g2 = obj_plain.value_and_grad(c, batch_mat, 0.2)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-8)
+
+    hd1 = obj_norm.hessian_diagonal(c, batch_raw, 0.2)
+    hd2 = obj_plain.hessian_diagonal(c, batch_mat, 0.2)
+    np.testing.assert_allclose(np.asarray(hd1), np.asarray(hd2), rtol=1e-8)
+
+    hv1 = obj_norm.hessian_vector(c, c, batch_raw, 0.2)
+    hv2 = obj_plain.hessian_vector(c, c, batch_mat, 0.2)
+    np.testing.assert_allclose(np.asarray(hv1), np.asarray(hv2), rtol=1e-8)
+
+
+def test_model_space_round_trip(rng):
+    x, y, w, off, coef = _problem(rng)
+    d = x.shape[1]
+    factors = rng.random(d) + 0.5
+    shifts = rng.normal(0, 1, d)
+    factors[-1], shifts[-1] = 1.0, 0.0
+    norm = NormalizationContext(jnp.asarray(factors), jnp.asarray(shifts), d - 1)
+    c = jnp.asarray(coef)
+    back = norm.model_to_normalized_space(norm.model_to_original_space(c))
+    np.testing.assert_allclose(np.asarray(back), coef, rtol=1e-10)
+
+    # Predictions with original-space model on raw x == normalized-space
+    # model on normalized x.
+    orig = np.asarray(norm.model_to_original_space(c))
+    x_norm = (x - shifts) * factors
+    np.testing.assert_allclose(x @ orig, x_norm @ coef, rtol=1e-8)
+
+
+def test_csr_matches_dense(rng):
+    n, d = 50, 12
+    mat = sp.random(n, d, density=0.3, random_state=7, format="csr")
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    coef = rng.normal(0, 1, d)
+
+    dense = make_batch(DenseFeatures(jnp.asarray(mat.toarray())), y)
+    csr = make_batch(csr_from_scipy(mat, dtype=jnp.float64, pad_to=mat.nnz + 17), y)
+
+    obj = GLMObjective(PoissonLoss)
+    yv = (np.abs(y) + 1).astype(np.float64)
+    dense = make_batch(DenseFeatures(jnp.asarray(mat.toarray())), yv)
+    csr = make_batch(csr_from_scipy(mat, dtype=jnp.float64, pad_to=mat.nnz + 17), yv)
+    c = jnp.asarray(coef)
+    v1, g1 = obj.value_and_grad(c, dense, 0.05)
+    v2, g2 = obj.value_and_grad(c, csr, 0.05)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(obj.hessian_diagonal(c, dense)),
+        np.asarray(obj.hessian_diagonal(c, csr)), rtol=1e-9)
+
+
+def test_zero_weight_rows_are_inert(rng):
+    """Weight-0 padding must not affect value/grad — ragged blocks rely on it."""
+    x, y, w, off, coef = _problem(rng, n=20)
+    w[10:] = 0.0
+    obj = GLMObjective(LogisticLoss)
+    full = make_batch(DenseFeatures(jnp.asarray(x)), y, off, w)
+    trimmed = make_batch(DenseFeatures(jnp.asarray(x[:10])), y[:10], off[:10],
+                         w[:10])
+    c = jnp.asarray(coef)
+    v1, g1 = obj.value_and_grad(c, full)
+    v2, g2 = obj.value_and_grad(c, trimmed)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-10)
+
+
+def test_vmap_over_entities(rng):
+    """The objective vmaps over a leading entity axis — the core of the
+    random-effect solver design (SURVEY §2.3 entity sharding)."""
+    B, n, d = 4, 15, 6
+    xs = rng.normal(0, 1, (B, n, d))
+    ys = (rng.random((B, n)) < 0.5).astype(np.float64)
+    coefs = rng.normal(0, 1, (B, d))
+    obj = GLMObjective(LogisticLoss)
+
+    def one(c, x, y):
+        return obj.value_and_grad(
+            c, make_batch(DenseFeatures(x), y), 0.1)
+
+    vals, grads = jax.vmap(one)(jnp.asarray(coefs), jnp.asarray(xs),
+                                jnp.asarray(ys))
+    for b in range(B):
+        v, g = one(jnp.asarray(coefs[b]), jnp.asarray(xs[b]), jnp.asarray(ys[b]))
+        np.testing.assert_allclose(float(vals[b]), float(v), rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(grads[b]), np.asarray(g),
+                                   rtol=1e-10)
