@@ -1,0 +1,39 @@
+"""Optimizers as XLA-compilable state machines.
+
+One implementation per algorithm, three execution modes (the reference needed
+two parallel class hierarchies — Distributed*/SingleNode* — for this;
+here mode is just where the arrays live):
+
+- local: jit on one device
+- batched: ``vmap`` over an entity axis (random effects)
+- distributed: data sharded over a mesh; gradient sums become ICI
+  all-reduces inserted by XLA's SPMD partitioner
+"""
+
+from photon_ml_tpu.optimization.convergence import (
+    ConvergenceReason,
+    OptimizerResult,
+)
+from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimization.owlqn import minimize_owlqn
+from photon_ml_tpu.optimization.tron import minimize_tron
+from photon_ml_tpu.optimization.config import (
+    OptimizerType,
+    RegularizationType,
+    OptimizerConfig,
+    RegularizationContext,
+    GLMOptimizationConfiguration,
+)
+
+__all__ = [
+    "ConvergenceReason",
+    "OptimizerResult",
+    "minimize_lbfgs",
+    "minimize_owlqn",
+    "minimize_tron",
+    "OptimizerType",
+    "RegularizationType",
+    "OptimizerConfig",
+    "RegularizationContext",
+    "GLMOptimizationConfiguration",
+]

@@ -1,0 +1,78 @@
+"""Convergence reasons and optimizer results.
+
+Semantics mirror the reference's Optimizer template
+(ml/optimization/Optimizer.scala:156-170, ml/util/ConvergenceReason.scala):
+an optimizer stops when
+  - iteration count hits max_iter                        -> MAX_ITERATIONS
+  - |f_k - f_{k-1}| <= tol * |f_0|                       -> FUNCTION_VALUES_CONVERGED
+  - ||g_k|| <= tol * ||g_0||                             -> GRADIENT_CONVERGED
+  - the line search / trust region cannot improve        -> OBJECTIVE_NOT_IMPROVING
+
+Reasons are small ints so they live inside jitted state and vmap lanes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+
+Array = jax.Array
+
+
+class ConvergenceReason(enum.IntEnum):
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+    @property
+    def summary(self) -> str:
+        return {
+            ConvergenceReason.NOT_CONVERGED: "not converged",
+            ConvergenceReason.MAX_ITERATIONS: "max iterations reached",
+            ConvergenceReason.FUNCTION_VALUES_CONVERGED:
+                "objective function values converged",
+            ConvergenceReason.GRADIENT_CONVERGED: "gradient converged",
+            ConvergenceReason.OBJECTIVE_NOT_IMPROVING:
+                "objective is not improving",
+        }[self]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class OptimizerResult:
+    """Solution + telemetry. Fully array-valued, so it vmaps/shards cleanly.
+
+    The per-iteration ``value_history``/``grad_norm_history`` arrays (padded
+    to max_iter+1, valid up to ``iterations``) are the TPU replacement for the
+    reference's OptimizationStatesTracker ring
+    (ml/optimization/OptimizationStatesTracker.scala).
+    """
+
+    x: Array
+    value: Array
+    grad_norm: Array
+    iterations: Array  # i32
+    reason: Array  # i32, a ConvergenceReason value
+    value_history: Array
+    grad_norm_history: Array
+
+    @property
+    def converged(self) -> Array:
+        return self.reason != int(ConvergenceReason.NOT_CONVERGED)
+
+    def reason_enum(self) -> ConvergenceReason:
+        return ConvergenceReason(int(self.reason))
+
+    def tree_flatten(self):
+        return (
+            self.x, self.value, self.grad_norm, self.iterations, self.reason,
+            self.value_history, self.grad_norm_history,
+        ), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)

@@ -1,0 +1,294 @@
+"""TRON: trust-region Newton with truncated conjugate gradient.
+
+TPU-native counterpart of the reference's LIBLINEAR port
+(ml/optimization/TRON.scala:153-340): an outer trust-region loop whose inner
+CG performs one Hessian-vector product per iteration. In the reference each
+Hv product is a distributed treeAggregate; here it is a jvp-of-grad through
+the fused GLM objective — under data sharding XLA turns the contraction into
+an ICI all-reduce, and under ``vmap`` the whole solver batches over entities.
+
+Trust-region update rules follow LIBLINEAR (sigma1/sigma2/sigma3,
+eta0/eta1/eta2); the improvement-failure budget mirrors
+TRON.scala's maxNumImprovementFailures=5 (ml/optimization/TRON.scala:258-264).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optimization.convergence import (
+    ConvergenceReason,
+    OptimizerResult,
+)
+from photon_ml_tpu.optimization.lbfgs import _project
+
+Array = jax.Array
+
+_ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
+_SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
+_CG_XI = 0.1  # inner CG stops at ||r|| <= xi ||g||
+
+
+def _truncated_cg(hvp, g, delta, max_cg, dtype):
+    """Steihaug-Toint truncated CG: approximately solve H s = -g, ||s||<=delta.
+
+    Returns (s, r) with r the final residual -g - H s (needed for the
+    predicted-reduction formula). One hvp per iteration — the hot loop
+    (reference: TRON.scala:280-340).
+    """
+    d0 = -g
+    s0 = jnp.zeros_like(g)
+    r0 = -g
+    rtr0 = jnp.vdot(r0, r0)
+    stop_norm = _CG_XI * jnp.linalg.norm(g)
+
+    class CGState(NamedTuple):
+        s: Array
+        r: Array
+        d: Array
+        rtr: Array
+        k: Array
+        done: Array
+
+    init = CGState(s0, r0, d0, rtr0, jnp.zeros((), jnp.int32),
+                   jnp.linalg.norm(r0) <= stop_norm)
+
+    def cond(st: CGState):
+        return jnp.logical_and(~st.done, st.k < max_cg)
+
+    def body(st: CGState):
+        hd = hvp(st.d)
+        dhd = jnp.vdot(st.d, hd)
+        # Guard: non-positive curvature direction -> march to the boundary.
+        alpha = st.rtr / jnp.where(dhd > 0, dhd, jnp.asarray(1.0, dtype))
+        s_try = st.s + alpha * st.d
+
+        crossed = jnp.logical_or(jnp.linalg.norm(s_try) > delta, dhd <= 0)
+
+        # Boundary intersection: tau >= 0 with ||s + tau d|| = delta.
+        std = jnp.vdot(st.s, st.d)
+        dd = jnp.vdot(st.d, st.d)
+        ss = jnp.vdot(st.s, st.s)
+        gap = jnp.maximum(delta * delta - ss, 0.0)
+        rad = jnp.sqrt(jnp.maximum(std * std + dd * gap, 0.0))
+        safe_dd = jnp.maximum(dd, 1e-30)
+        tau = jnp.where(
+            std >= 0, gap / jnp.maximum(std + rad, 1e-30), (rad - std) / safe_dd
+        )
+
+        step = jnp.where(crossed, tau, alpha)
+        s_new = st.s + step * st.d
+        r_new = st.r - step * hd
+
+        rtr_new = jnp.vdot(r_new, r_new)
+        beta = rtr_new / jnp.maximum(st.rtr, 1e-30)
+        d_new = r_new + beta * st.d
+
+        done_new = jnp.logical_or(
+            crossed, jnp.sqrt(rtr_new) <= stop_norm
+        )
+        new = CGState(s_new, r_new, d_new, rtr_new, st.k + 1, done_new)
+        return jax.tree.map(lambda a, b: jnp.where(st.done, a, b), st, new)
+
+    final = lax.while_loop(cond, body, init)
+    return final.s, final.r
+
+
+class _TronState(NamedTuple):
+    x: Array
+    f: Array
+    g: Array
+    delta: Array
+    it: Array  # accepted iterations
+    fails: Array  # consecutive improvement failures
+    reason: Array
+    value_hist: Array
+    gnorm_hist: Array
+    first: Array  # bool: before first step (delta clamp rule)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fun", "max_iter", "tol", "max_cg",
+                     "max_improvement_failures", "has_bounds"),
+)
+def _minimize_tron_impl(
+    fun, x0, args, lower, upper, *, max_iter, tol, max_cg,
+    max_improvement_failures, has_bounds,
+) -> OptimizerResult:
+    vg = jax.value_and_grad(fun)
+    dtype = x0.dtype
+    lo = lower if has_bounds else None
+    hi = upper if has_bounds else None
+
+    def proj_grad_norm(x, g):
+        # Norm of the projected gradient: ||x - P(x - g)||. Equals ||g|| in
+        # the unconstrained case; the right stationarity measure with bounds.
+        if not has_bounds:
+            return jnp.linalg.norm(g)
+        return jnp.linalg.norm(x - _project(x - g, lo, hi))
+
+    x0 = _project(x0, lo, hi)
+    f0, g0 = vg(x0, *args)
+    gnorm0 = proj_grad_norm(x0, g0)
+    f0_scale = jnp.maximum(jnp.abs(f0), jnp.asarray(1e-30, dtype))
+
+    value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
+    gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+
+    init = _TronState(
+        x=x0, f=f0, g=g0, delta=gnorm0,
+        it=jnp.zeros((), jnp.int32), fails=jnp.zeros((), jnp.int32),
+        reason=jnp.where(
+            gnorm0 <= 0.0, int(ConvergenceReason.GRADIENT_CONVERGED),
+            int(ConvergenceReason.NOT_CONVERGED)).astype(jnp.int32),
+        value_hist=value_hist, gnorm_hist=gnorm_hist,
+        first=jnp.ones((), bool),
+    )
+
+    def cond(st: _TronState):
+        return st.reason == int(ConvergenceReason.NOT_CONVERGED)
+
+    def body(st: _TronState):
+        def hvp(v):
+            grad_fn = lambda xx: vg(xx, *args)[1]
+            return jax.jvp(grad_fn, (st.x,), (v,))[1]
+
+        if has_bounds:
+            # Active-set reduction: coordinates pinned at a bound with the
+            # gradient pushing outward are frozen; CG runs in the free
+            # subspace so the Newton model isn't polluted by directions the
+            # projection will clip anyway.
+            eps = jnp.asarray(1e-12, dtype)
+            active = jnp.logical_or(
+                jnp.logical_and(st.x <= lo + eps, st.g > 0),
+                jnp.logical_and(st.x >= hi - eps, st.g < 0),
+            )
+            free = (~active).astype(dtype)
+            g_cg = st.g * free
+            hvp_cg = lambda v: free * hvp(free * v)
+        else:
+            g_cg, hvp_cg = st.g, hvp
+
+        s, r = _truncated_cg(hvp_cg, g_cg, st.delta, max_cg, dtype)
+
+        x_try = _project(st.x + s, lo, hi)
+        s_real = x_try - st.x
+        f_new, g_new = vg(x_try, *args)
+
+        gs = jnp.vdot(st.g, s_real)
+        if has_bounds:
+            # Projection changed the step; evaluate the quadratic model on the
+            # realized step for a consistent predicted reduction.
+            prered = -(gs + 0.5 * jnp.vdot(s_real, hvp(s_real)))
+        else:
+            prered = -0.5 * (gs - jnp.vdot(s_real, r))
+        actred = st.f - f_new
+        snorm = jnp.linalg.norm(s_real)
+
+        delta = jnp.where(st.first, jnp.minimum(st.delta, snorm), st.delta)
+
+        # LIBLINEAR step-size interpolation for the radius update.
+        denom = f_new - st.f - gs
+        alpha = jnp.where(
+            denom <= 0, _SIGMA3,
+            jnp.maximum(_SIGMA1, -0.5 * (gs / jnp.maximum(denom, 1e-30))),
+        )
+        alpha_s = alpha * snorm
+        delta = jnp.where(
+            actred < _ETA0 * prered,
+            jnp.minimum(jnp.maximum(alpha, _SIGMA1) * snorm, _SIGMA2 * delta),
+            jnp.where(
+                actred < _ETA1 * prered,
+                jnp.maximum(_SIGMA1 * delta,
+                            jnp.minimum(alpha_s, _SIGMA2 * delta)),
+                jnp.where(
+                    actred < _ETA2 * prered,
+                    jnp.maximum(_SIGMA1 * delta,
+                                jnp.minimum(alpha_s, _SIGMA3 * delta)),
+                    jnp.maximum(delta, jnp.minimum(alpha_s, _SIGMA3 * delta)),
+                ),
+            ),
+        )
+
+        accept = jnp.logical_and(actred > _ETA0 * prered, jnp.isfinite(f_new))
+        it_new = st.it + jnp.where(accept, 1, 0).astype(jnp.int32)
+        fails_new = jnp.where(accept, 0, st.fails + 1).astype(jnp.int32)
+
+        x_acc = jnp.where(accept, x_try, st.x)
+        f_acc = jnp.where(accept, f_new, st.f)
+        g_acc = jnp.where(accept, g_new, st.g)
+        gnorm_acc = proj_grad_norm(x_acc, g_acc)
+        f_delta = jnp.abs(st.f - f_acc)
+
+        reason = jnp.where(
+            fails_new > max_improvement_failures,
+            int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
+            jnp.where(
+                jnp.logical_and(accept, gnorm_acc <= tol * gnorm0),
+                int(ConvergenceReason.GRADIENT_CONVERGED),
+                jnp.where(
+                    jnp.logical_and(accept, f_delta <= tol * f0_scale),
+                    int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
+                    jnp.where(
+                        it_new >= max_iter,
+                        int(ConvergenceReason.MAX_ITERATIONS),
+                        int(ConvergenceReason.NOT_CONVERGED)))),
+        ).astype(jnp.int32)
+
+        new = _TronState(
+            x=x_acc, f=f_acc, g=g_acc, delta=delta, it=it_new,
+            fails=fails_new, reason=reason,
+            value_hist=jnp.where(
+                accept, st.value_hist.at[it_new].set(f_acc), st.value_hist),
+            gnorm_hist=jnp.where(
+                accept, st.gnorm_hist.at[it_new].set(gnorm_acc),
+                st.gnorm_hist),
+            first=jnp.zeros((), bool),
+        )
+        done = ~cond(st)
+        return jax.tree.map(lambda a, b: jnp.where(done, a, b), st, new)
+
+    final = lax.while_loop(cond, body, init)
+    return OptimizerResult(
+        x=final.x, value=final.f, grad_norm=jnp.linalg.norm(final.g),
+        iterations=final.it, reason=final.reason,
+        value_history=final.value_hist, grad_norm_history=final.gnorm_hist,
+    )
+
+
+def minimize_tron(
+    fun: Callable[..., Array],
+    x0: Array,
+    args: Tuple[Any, ...] = (),
+    *,
+    max_iter: int = 15,
+    tol: float = 1e-5,
+    max_cg: int = 20,
+    max_improvement_failures: int = 5,
+    lower_bounds: Optional[Array] = None,
+    upper_bounds: Optional[Array] = None,
+) -> OptimizerResult:
+    """Minimize twice-differentiable ``fun(x, *args)`` from ``x0``.
+
+    Defaults mirror the reference (maxIter=15, tol=1e-5, <=20 CG iterations,
+    <=5 improvement failures; ml/optimization/TRON.scala:258-264).
+    """
+    x0 = jnp.asarray(x0)
+    dtype = x0.dtype
+    has_bounds = lower_bounds is not None or upper_bounds is not None
+    d = x0.shape[-1]
+    lo = (jnp.full((d,), -jnp.inf, dtype) if lower_bounds is None
+          else jnp.asarray(lower_bounds, dtype))
+    hi = (jnp.full((d,), jnp.inf, dtype) if upper_bounds is None
+          else jnp.asarray(upper_bounds, dtype))
+    return _minimize_tron_impl(
+        fun, x0, args, lo, hi, max_iter=max_iter, tol=tol, max_cg=max_cg,
+        max_improvement_failures=max_improvement_failures,
+        has_bounds=has_bounds,
+    )

@@ -121,9 +121,6 @@ def test_csr_matches_dense(rng):
     y = (rng.random(n) < 0.5).astype(np.float64)
     coef = rng.normal(0, 1, d)
 
-    dense = make_batch(DenseFeatures(jnp.asarray(mat.toarray())), y)
-    csr = make_batch(csr_from_scipy(mat, dtype=jnp.float64, pad_to=mat.nnz + 17), y)
-
     obj = GLMObjective(PoissonLoss)
     yv = (np.abs(y) + 1).astype(np.float64)
     dense = make_batch(DenseFeatures(jnp.asarray(mat.toarray())), yv)

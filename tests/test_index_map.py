@@ -1,0 +1,78 @@
+"""Index map tests (reference: util/PalDBIndexMapTest, DefaultIndexMapTest)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.index_map import (
+    DELIMITER,
+    INTERCEPT_KEY,
+    IdentityIndexMap,
+    IndexMap,
+    feature_key,
+    split_key,
+)
+from photon_ml_tpu.optimization.config import (
+    constraint_arrays,
+    parse_constraint_string,
+)
+
+
+def test_feature_key_uses_control_byte_delimiter():
+    assert DELIMITER == ""
+    # name='ab',term='c' must NOT collide with name='a',term='bc'.
+    assert feature_key("ab", "c") != feature_key("a", "bc")
+    assert split_key(feature_key("n", "t")) == ("n", "t")
+    assert split_key(feature_key("n")) == ("n", "")
+
+
+def test_round_trip_and_missing_key(tmp_path):
+    m = IndexMap.from_name_terms([("b", ""), ("a", "x"), ("a", "")],
+                                 add_intercept=True)
+    assert len(m) == 4
+    assert m.intercept_index == 3  # intercept appended last
+    assert m.get_index(feature_key("nope")) == -1
+    assert m.get_feature_name(m.get_index(feature_key("a", "x"))) == \
+        feature_key("a", "x")
+    p = tmp_path / "imap.json"
+    m.save(p)
+    m2 = IndexMap.load(p)
+    assert dict(m2.key_items()) == dict(m.key_items())
+
+
+def test_identity_index_map():
+    m = IdentityIndexMap(5, intercept_last=True)
+    assert m.get_index(feature_key("0")) == 0
+    assert m.get_index(feature_key("3")) == 3
+    assert m.intercept_index == 4
+
+
+def test_duplicate_indices_rejected():
+    with pytest.raises(ValueError):
+        IndexMap({"a": 0, "b": 0})
+
+
+def test_constraint_parsing_with_wildcards():
+    m = IndexMap.from_name_terms(
+        [("f1", ""), ("f2", "t1"), ("f2", "t2")], add_intercept=True)
+    s = ('[{"name": "f2", "term": "*", "lowerBound": -1.0, "upperBound": 1.0},'
+         ' {"name": "f1", "term": "", "lowerBound": 0.0}]')
+    cmap = parse_constraint_string(s, m)
+    assert cmap[m.get_index(feature_key("f2", "t1"))] == (-1.0, 1.0)
+    assert cmap[m.get_index(feature_key("f2", "t2"))] == (-1.0, 1.0)
+    assert cmap[m.get_index(feature_key("f1"))] == (0.0, float("inf"))
+
+    lo, hi = constraint_arrays(cmap, len(m), intercept_id=m.intercept_index)
+    assert lo.shape == (4,)
+    assert np.isneginf(lo[m.intercept_index]) and np.isposinf(hi[m.intercept_index])
+    assert lo[m.get_index(feature_key("f1"))] == 0.0
+
+
+def test_constraint_global_wildcard_and_validation():
+    m = IndexMap.from_name_terms([("f1", ""), ("f2", "")])
+    cmap = parse_constraint_string(
+        '[{"name": "*", "term": "*", "lowerBound": -2, "upperBound": 2}]', m)
+    assert set(cmap) == {0, 1}
+    with pytest.raises(ValueError):
+        parse_constraint_string(
+            '[{"name": "f1", "term": "", "lowerBound": 3, "upperBound": 1}]', m)
+    assert constraint_arrays(None, 3) == (None, None)

@@ -1,0 +1,67 @@
+"""Config parsing parity tests (reference:
+GLMOptimizationConfigurationTest, RegularizationContextTest)."""
+
+import pytest
+
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+)
+
+
+def test_parse_six_field_string():
+    c = GLMOptimizationConfiguration.parse("10,1e-5,0.3,0.5,TRON,L2")
+    assert c.max_iterations == 10
+    assert c.tolerance == 1e-5
+    assert c.regularization_weight == 0.3
+    assert c.down_sampling_rate == 0.5
+    assert c.optimizer_type == OptimizerType.TRON
+    assert c.regularization_context.reg_type == RegularizationType.L2
+
+
+def test_parse_elastic_net_with_alpha():
+    c = GLMOptimizationConfiguration.parse("50,1e-6,1.0,1.0,LBFGS,ELASTIC_NET,0.4")
+    rc = c.regularization_context
+    assert rc.reg_type == RegularizationType.ELASTIC_NET
+    assert rc.l1_weight(10.0) == pytest.approx(4.0)
+    assert rc.l2_weight(10.0) == pytest.approx(6.0)
+
+
+def test_round_trip_string_and_json():
+    for s in ["10,1e-5,0.3,0.5,TRON,L2", "50,1e-06,1.0,1.0,LBFGS,ELASTIC_NET,0.4"]:
+        c = GLMOptimizationConfiguration.parse(s)
+        assert GLMOptimizationConfiguration.parse(c.to_string()) == c
+        assert GLMOptimizationConfiguration.from_json(c.to_json()) == c
+
+
+@pytest.mark.parametrize("bad", [
+    "10,1e-5,0.3,0.5,TRON",  # five fields
+    "10,1e-5,0.3,1.5,TRON,L2",  # sampling rate > 1
+    "10,1e-5,-0.3,0.5,TRON,L2",  # negative reg weight
+    "0,1e-5,0.3,0.5,TRON,L2",  # zero iterations
+    "10,1e-5,0.3,0.5,ADAM,L2",  # unknown optimizer
+])
+def test_parse_rejects_bad_strings(bad):
+    with pytest.raises(ValueError):
+        GLMOptimizationConfiguration.parse(bad)
+
+
+def test_regularization_context_validation():
+    with pytest.raises(ValueError):
+        RegularizationContext(RegularizationType.ELASTIC_NET, None)
+    with pytest.raises(ValueError):
+        RegularizationContext(RegularizationType.ELASTIC_NET, 1.5)
+    with pytest.raises(ValueError):
+        RegularizationContext(RegularizationType.L2, 0.5)
+    rc = RegularizationContext(RegularizationType.L1)
+    assert rc.l1_weight(3.0) == 3.0 and rc.l2_weight(3.0) == 0.0
+
+
+def test_optimizer_config_defaults():
+    assert OptimizerConfig(OptimizerType.LBFGS).resolved().max_iterations == 100
+    assert OptimizerConfig(OptimizerType.TRON).resolved().tolerance == 1e-5
+    c = OptimizerConfig(OptimizerType.TRON, 7, 1e-3, {2: (0.0, 1.0)})
+    assert OptimizerConfig.from_json(c.to_json()) == c
