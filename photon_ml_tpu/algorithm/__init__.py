@@ -1,0 +1,15 @@
+"""GAME block-coordinate-descent algorithm layer."""
+
+from photon_ml_tpu.algorithm.coordinates import (
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
+
+__all__ = [
+    "Coordinate",
+    "FixedEffectCoordinate",
+    "RandomEffectCoordinate",
+    "CoordinateDescent",
+]

@@ -1,0 +1,334 @@
+"""Random-effect datasets: per-entity problems as bucketed, padded, vmappable
+dense blocks.
+
+This module is the TPU re-design of the reference's entity-sharded layer
+(ml/data/RandomEffectDataSet.scala:40-395, LocalDataSet.scala:34-304,
+RandomEffectDataSetPartitioner.scala): instead of RDD[(entityId, LocalDataSet)]
+with per-entity Breeze solves inside executor tasks, entities are
+
+1. grouped by id (host, once, at ingest — replacing the groupByKey shuffle);
+2. capped by reservoir sampling with survivor reweighting (sampling.py);
+3. projected into their *observed* feature subspace — the union of nonzero
+   columns (+ intercept), optionally Pearson-filtered — which is the
+   reference's IndexMapProjector (ml/projector/IndexMapProjector.scala:42-106)
+   realized as a column gather;
+4. bucketed by padded (n_rows, n_features) size classes, each bucket one
+   dense ``f[E, n_pad, d_pad]`` block solved by a single `vmap`-batched
+   L-BFGS kernel and shardable over chips along the entity axis.
+
+Rows beyond the active cap form "passive" blocks: scored with the entity's
+model but not trained on (RandomEffectDataSet.scala:328-369).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.sampling import reservoir_sample
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Configuration (reference: ml/data/RandomEffectDataConfiguration.scala:1-127)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfiguration:
+    random_effect_type: str  # which id column groups the rows
+    feature_shard_id: str
+    num_active_data_points: Optional[int] = None  # reservoir cap
+    num_passive_data_points_lower_bound: Optional[int] = None
+    num_features_to_samples_ratio: Optional[float] = None  # Pearson cap
+    projector_type: str = "INDEX_MAP"  # INDEX_MAP | IDENTITY | RANDOM=<d>
+
+    @classmethod
+    def parse(cls, s: str) -> "RandomEffectDataConfiguration":
+        """Parse the reference's comma string:
+        'reType,shardId,numPartitions,activeBound,passiveBound,ratio,projector'
+        (numPartitions is Spark partitioning — meaningless on a mesh, accepted
+        and ignored for CLI compatibility)."""
+        p = [t.strip() for t in s.split(",")]
+        if len(p) not in (6, 7):
+            raise ValueError(
+                "expected 'reType,shardId,numPartitions,activeBound,"
+                f"passiveBound,ratio[,projector]', got {s!r}")
+        maybe = lambda v, cast: (None if v.lower() in ("none", "-1", "")
+                                 else cast(v))
+        return cls(
+            random_effect_type=p[0],
+            feature_shard_id=p[1],
+            num_active_data_points=maybe(p[3], int),
+            num_passive_data_points_lower_bound=maybe(p[4], int),
+            num_features_to_samples_ratio=maybe(p[5], float),
+            projector_type=p[6].upper() if len(p) == 7 else "INDEX_MAP",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Device block
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class EntityBlock:
+    """One bucket of entities with identical padded shapes.
+
+    Padding contracts:
+    - rows: weight 0, row_id == sentinel (the global n_rows slot);
+    - local feature columns: all-zero x column, feat_idx == -1 (gathers from
+      a zeros-extended global coefficient vector).
+    """
+
+    x: Array  # f[E, n_pad, d_pad]
+    labels: Array  # f[E, n_pad]
+    offsets: Array  # f[E, n_pad]
+    weights: Array  # f[E, n_pad]
+    row_ids: Array  # i32[E, n_pad], == n_rows for padding
+    feat_idx: Array  # i32[E, d_pad], == -1 for padding
+
+    @property
+    def num_entities(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def n_pad(self) -> int:
+        return self.x.shape[1]
+
+    @property
+    def d_pad(self) -> int:
+        return self.x.shape[2]
+
+    def local_margins(self, coefs: Array) -> Array:
+        """x @ coef per entity: [E, n_pad]."""
+        return jnp.einsum("end,ed->en", self.x, coefs)
+
+    def tree_flatten(self):
+        return (self.x, self.labels, self.offsets, self.weights,
+                self.row_ids, self.feat_idx), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """All buckets for one random-effect coordinate."""
+
+    config: RandomEffectDataConfiguration
+    blocks: List[EntityBlock]  # active data
+    passive_blocks: List[Optional[EntityBlock]]  # aligned with blocks
+    entity_codes: List[np.ndarray]  # [E] global entity code per block slot
+    vocabulary: np.ndarray  # entity name per code
+    n_rows: int  # global row count == scatter sentinel
+    num_global_features: int
+
+    @property
+    def num_entities(self) -> int:
+        return sum(len(c) for c in self.entity_codes)
+
+    def scatter_scores(self, per_block_margins: Sequence[Array],
+                       passive_margins: Sequence[Optional[Array]]) -> Array:
+        """Assemble a global dense score vector from per-entity local margins.
+
+        The TPU replacement for the reference's score joins
+        (RandomEffectCoordinate.scala:142-152, 179-200): every row belongs to
+        exactly one entity, so a scatter-add into a sentinel-extended vector
+        is exact.
+        """
+        scores = jnp.zeros((self.n_rows + 1,),
+                           per_block_margins[0].dtype if per_block_margins
+                           else jnp.float32)
+        for block, m in zip(self.blocks, per_block_margins):
+            scores = scores.at[block.row_ids.reshape(-1)].add(m.reshape(-1))
+        for block, m in zip(self.passive_blocks, passive_margins):
+            if block is not None and m is not None:
+                scores = scores.at[block.row_ids.reshape(-1)].add(
+                    m.reshape(-1))
+        return scores[:-1]
+
+
+# ---------------------------------------------------------------------------
+# Pearson feature selection (reference: LocalDataSet.scala:116-140, 380-394)
+# ---------------------------------------------------------------------------
+
+
+def pearson_correlation_scores(
+    x: sp.csr_matrix, y: np.ndarray, intercept_col: Optional[int]
+) -> np.ndarray:
+    """|Pearson corr(feature_j, label)| per column of a small CSR block.
+
+    Constant columns get score 0; the intercept column (constant by
+    construction) gets +inf so it always survives selection — mirroring
+    LocalDataSet.filterFeaturesByPearsonCorrelationScore's special-casing.
+    """
+    n = x.shape[0]
+    y = np.asarray(y, np.float64)
+    y_c = y - y.mean()
+    y_ss = float(y_c @ y_c)
+    xs = np.asarray(x.sum(axis=0)).ravel()
+    x_mean = xs / n
+    x_sq = np.asarray(x.multiply(x).sum(axis=0)).ravel()
+    x_var = x_sq - n * x_mean**2
+    xy = np.asarray(x.T @ y).ravel()
+    cov = xy - n * x_mean * y.mean()
+    denom = np.sqrt(np.maximum(x_var, 0) * max(y_ss, 0))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        corr = np.where(denom > 1e-12, np.abs(cov / np.maximum(denom, 1e-300)),
+                        0.0)
+    if intercept_col is not None and 0 <= intercept_col < x.shape[1]:
+        corr[intercept_col] = np.inf
+    return corr
+
+
+def _next_size(v: int, minimum: int) -> int:
+    """Smallest power of two >= max(v, minimum) — the bucket size classes."""
+    v = max(v, minimum)
+    return 1 << (v - 1).bit_length()
+
+
+@dataclasses.dataclass
+class _EntityRows:
+    code: int
+    active: np.ndarray  # global row indices
+    passive: np.ndarray
+    weight_multiplier: float
+    local_cols: np.ndarray  # selected global feature columns
+
+
+def build_random_effect_dataset(
+    data: GameDataset,
+    config: RandomEffectDataConfiguration,
+    seed: int = 0,
+    intercept_col: Optional[int] = None,
+    dtype=jnp.float32,
+    min_rows_pad: int = 4,
+    min_cols_pad: int = 8,
+) -> RandomEffectDataset:
+    """Group → cap → select → bucket. Host-side, runs once at ingest
+    (replacing the reference's per-iteration Spark shuffles)."""
+    if config.projector_type.startswith("RANDOM"):
+        raise NotImplementedError(
+            "RANDOM projection for random-effect datasets is not implemented "
+            "yet; use INDEX_MAP or IDENTITY")
+    identity = config.projector_type == "IDENTITY"
+
+    col = data.id_columns[config.random_effect_type]
+    mat = data.feature_shards[config.feature_shard_id].tocsr()
+    n_rows, d_global = mat.shape
+    rng = np.random.default_rng(seed)
+
+    from photon_ml_tpu.data.game_data import group_rows_by_code
+    groups = group_rows_by_code(col.codes)
+
+    entities: List[_EntityRows] = []
+    for rows in groups:
+        code = int(col.codes[rows[0]])
+        cap = config.num_active_data_points
+        if cap is not None and len(rows) > cap:
+            sel, mult = reservoir_sample(rng, len(rows), cap)
+            active = rows[sel]
+            passive_mask = np.ones(len(rows), bool)
+            passive_mask[sel] = False
+            passive = rows[passive_mask]
+            lb = config.num_passive_data_points_lower_bound
+            if lb is not None and len(passive) < lb:
+                passive = np.empty((0,), np.int64)
+        else:
+            active, passive, mult = rows, np.empty((0,), np.int64), 1.0
+
+        sub = mat[active]
+        if identity:
+            observed = np.arange(d_global)
+        else:
+            observed = (np.unique(sub.indices) if sub.nnz
+                        else np.empty((0,), np.int64))
+            if intercept_col is not None and intercept_col not in observed:
+                observed = np.append(observed, intercept_col)
+        ratio = config.num_features_to_samples_ratio
+        if ratio is not None and len(observed) > 0:
+            keep = max(1, int(np.ceil(ratio * len(active))))
+            if keep < len(observed):
+                scores = pearson_correlation_scores(
+                    sub[:, observed], data.responses[active],
+                    int(np.flatnonzero(observed == intercept_col)[0])
+                    if intercept_col is not None and
+                    intercept_col in observed else None)
+                top = np.argsort(-scores, kind="stable")[:keep]
+                observed = observed[np.sort(top)]
+        observed = np.sort(observed)
+        entities.append(_EntityRows(code, active, passive, mult, observed))
+
+    # Bucket by padded size classes.
+    buckets: Dict[Tuple[int, int, int], List[_EntityRows]] = {}
+    for e in entities:
+        n_pad = _next_size(len(e.active), min_rows_pad)
+        d_pad = _next_size(max(len(e.local_cols), 1), min_cols_pad)
+        p_pad = _next_size(len(e.passive), 1) if len(e.passive) else 0
+        buckets.setdefault((n_pad, d_pad, p_pad), []).append(e)
+
+    blocks, passive_blocks, codes_per_block = [], [], []
+    for (n_pad, d_pad, p_pad), members in sorted(buckets.items()):
+        blocks.append(_pack_block(
+            members, [m.active for m in members], n_pad, d_pad, data, mat,
+            n_rows, dtype, weight_mult=True))
+        if p_pad:
+            passive_blocks.append(_pack_block(
+                members, [m.passive for m in members], p_pad, d_pad, data,
+                mat, n_rows, dtype, weight_mult=False))
+        else:
+            passive_blocks.append(None)
+        codes_per_block.append(np.asarray([m.code for m in members],
+                                          np.int32))
+
+    return RandomEffectDataset(
+        config=config, blocks=blocks, passive_blocks=passive_blocks,
+        entity_codes=codes_per_block, vocabulary=col.vocabulary,
+        n_rows=n_rows, num_global_features=d_global,
+    )
+
+
+def _pack_block(
+    members: List[_EntityRows], row_sets: List[np.ndarray], n_pad: int,
+    d_pad: int, data: GameDataset, mat: sp.csr_matrix, n_rows: int, dtype,
+    weight_mult: bool,
+) -> EntityBlock:
+    E = len(members)
+    x = np.zeros((E, n_pad, d_pad), np.float32)
+    labels = np.zeros((E, n_pad), np.float32)
+    offsets = np.zeros((E, n_pad), np.float32)
+    weights = np.zeros((E, n_pad), np.float32)
+    row_ids = np.full((E, n_pad), n_rows, np.int32)
+    feat_idx = np.full((E, d_pad), -1, np.int32)
+
+    for i, (m, rows) in enumerate(zip(members, row_sets)):
+        k = len(rows)
+        if k == 0:
+            continue
+        cols = m.local_cols
+        sub = mat[rows][:, cols].toarray()
+        x[i, :k, :len(cols)] = sub
+        labels[i, :k] = data.responses[rows]
+        offsets[i, :k] = data.offsets[rows]
+        w = data.weights[rows]
+        weights[i, :k] = w * (m.weight_multiplier if weight_mult else 1.0)
+        row_ids[i, :k] = rows
+        feat_idx[i, :len(cols)] = cols
+
+    as_dev = lambda a: jnp.asarray(a, dtype) if a.dtype == np.float32 \
+        else jnp.asarray(a)
+    return EntityBlock(
+        x=as_dev(x), labels=as_dev(labels), offsets=as_dev(offsets),
+        weights=as_dev(weights), row_ids=jnp.asarray(row_ids),
+        feat_idx=jnp.asarray(feat_idx),
+    )

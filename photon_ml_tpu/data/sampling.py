@@ -1,0 +1,73 @@
+"""Samplers: per-entity reservoir caps (host, at ingest) and down-samplers
+(device, inside the training loop).
+
+Reference counterparts:
+- reservoir cap with survivor reweighting:
+  ml/data/RandomEffectDataSet.scala:254-317 + MinHeapWithFixedCapacity.scala
+- DefaultDownSampler / BinaryClassificationDownSampler:
+  ml/sampler/*.scala, applied in
+  ml/optimization/DistributedOptimizationProblem.scala:112-121
+
+On TPU the down-samplers do not drop rows (that would change array shapes):
+they draw an on-device Bernoulli mask and fold it into the weight vector,
+rescaling survivors by 1/rate so the objective stays unbiased — weight-0 rows
+are provably inert in the fused objective (see ops/glm_objective.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def reservoir_sample(
+    rng: np.random.Generator, n: int, cap: int
+) -> tuple[np.ndarray, float]:
+    """Pick `cap` of `n` rows uniformly; survivors' weights scale by n/cap.
+
+    Returns (sorted selected indices, weight multiplier). Matches the
+    reference's semantics (uniform subsample, aggregate weight preserved —
+    RandomEffectDataSet.scala:299-310) without the streaming heap, which
+    exists only because Spark combineByKey is a streaming fold.
+    """
+    if n <= cap:
+        return np.arange(n), 1.0
+    idx = rng.choice(n, size=cap, replace=False)
+    idx.sort()
+    return idx, n / cap
+
+
+def default_down_sampler(
+    key: Array, weights: Array, rate: float
+) -> Array:
+    """Keep each row with prob `rate`, rescale kept weights by 1/rate
+    (ml/sampler/DefaultDownSampler.scala:27-45)."""
+    mask = jax.random.bernoulli(key, rate, weights.shape)
+    return jnp.where(mask, weights / rate, 0.0)
+
+
+def binary_classification_down_sampler(
+    key: Array, labels: Array, weights: Array, rate: float
+) -> Array:
+    """Down-sample negatives only, rescaling their weights
+    (ml/sampler/BinaryClassificationDownSampler.scala:32-60)."""
+    mask = jax.random.bernoulli(key, rate, weights.shape)
+    is_neg = labels < 0.5
+    neg_w = jnp.where(mask, weights / rate, 0.0)
+    return jnp.where(is_neg, neg_w, weights)
+
+
+def down_sample_weights(
+    key: Array, labels: Array, weights: Array, rate: float,
+    is_classification: bool,
+) -> Array:
+    """Dispatch matching DownSampler selection in the reference
+    (ml/optimization/DistributedOptimizationProblem.scala:165-176)."""
+    if rate >= 1.0:
+        return weights
+    if is_classification:
+        return binary_classification_down_sampler(key, labels, weights, rate)
+    return default_down_sampler(key, weights, rate)

@@ -1,0 +1,27 @@
+"""Evaluators for validation metrics."""
+
+from photon_ml_tpu.evaluation.evaluators import (
+    Evaluator,
+    AreaUnderROCCurveEvaluator,
+    RMSEEvaluator,
+    LogisticLossEvaluator,
+    PoissonLossEvaluator,
+    SquaredLossEvaluator,
+    SmoothedHingeLossEvaluator,
+    ShardedAreaUnderROCCurveEvaluator,
+    ShardedPrecisionAtKEvaluator,
+    build_evaluator,
+)
+
+__all__ = [
+    "Evaluator",
+    "AreaUnderROCCurveEvaluator",
+    "RMSEEvaluator",
+    "LogisticLossEvaluator",
+    "PoissonLossEvaluator",
+    "SquaredLossEvaluator",
+    "SmoothedHingeLossEvaluator",
+    "ShardedAreaUnderROCCurveEvaluator",
+    "ShardedPrecisionAtKEvaluator",
+    "build_evaluator",
+]

@@ -1,0 +1,236 @@
+"""Evaluators: one scalar metric over (scores, labels, offsets, weights).
+
+Reference: ml/evaluation/Evaluator.scala:24-78 and the concrete evaluators in
+ml/evaluation/. Scores arrive as dense vectors aligned with the dataset's row
+order (no joins). ``better_than`` encodes per-metric ordering exactly as the
+reference does (higher-is-better for AUC/precision, lower for losses).
+
+Sharded evaluators group rows by an id column and average the local metric
+over groups (ml/evaluation/ShardedAreaUnderROCCurveEvaluator.scala,
+ShardedPrecisionAtKEvaluator.scala).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+
+def _as_np(a):
+    return np.asarray(a, np.float64)
+
+
+@dataclasses.dataclass(frozen=True)
+class Evaluator:
+    name: str
+
+    def evaluate(self, scores, labels, offsets=None, weights=None,
+                 data=None) -> float:
+        scores = _as_np(scores)
+        n = len(scores)
+        labels = _as_np(labels)
+        offsets = np.zeros(n) if offsets is None else _as_np(offsets)
+        weights = np.ones(n) if weights is None else _as_np(weights)
+        return self._evaluate(scores + offsets, labels, weights, data)
+
+    def evaluate_dataset(self, scores, data) -> float:
+        return self.evaluate(scores, data.responses, data.offsets,
+                             data.weights, data=data)
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        raise NotImplementedError
+
+    def better_than(self, a: float, b: Optional[float]) -> bool:
+        if b is None:
+            return True
+        return a > b if self.higher_is_better else a < b
+
+    @property
+    def higher_is_better(self) -> bool:
+        return False
+
+
+def area_under_roc_curve(scores, labels, weights=None) -> float:
+    """Weighted AUC via the Mann-Whitney statistic with midrank ties
+    (equivalent to MLlib BinaryClassificationMetrics' trapezoidal ROC)."""
+    scores = _as_np(scores)
+    labels = _as_np(labels)
+    w = np.ones(len(scores)) if weights is None else _as_np(weights)
+    pos = labels >= 0.5
+    w_pos = w[pos].sum()
+    w_neg = w[~pos].sum()
+    if w_pos == 0 or w_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    s = scores[order]
+    ww = w[order]
+    # Midranks with ties, weighted: rank = cumw below + (tie block w + own)/2.
+    ranks = np.empty(len(s))
+    i = 0
+    cum = 0.0
+    while i < len(s):
+        j = i
+        while j < len(s) and s[j] == s[i]:
+            j += 1
+        block_w = ww[i:j].sum()
+        # Weighted midrank: cum-weight below the tie block + half the block.
+        ranks[i:j] = cum + block_w / 2.0
+        cum += block_w
+        i = j
+    r = np.empty(len(s))
+    r[order] = ranks
+    u = (w[pos] * r[pos]).sum() - w_pos * w_pos / 2.0
+    return float(u / (w_pos * w_neg))
+
+
+@dataclasses.dataclass(frozen=True)
+class AreaUnderROCCurveEvaluator(Evaluator):
+    name: str = "AUC"
+
+    @property
+    def higher_is_better(self) -> bool:
+        return True
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        return area_under_roc_curve(pred, labels, weights)
+
+
+@dataclasses.dataclass(frozen=True)
+class RMSEEvaluator(Evaluator):
+    name: str = "RMSE"
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        return float(np.sqrt(
+            np.sum(weights * (pred - labels) ** 2) / np.sum(weights)))
+
+
+def _logistic_loss_np(z, y):
+    return np.log1p(np.exp(-np.abs(z))) + np.maximum(z, 0) - y * z
+
+
+@dataclasses.dataclass(frozen=True)
+class LogisticLossEvaluator(Evaluator):
+    name: str = "LOGISTIC_LOSS"
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        return float(np.sum(weights * _logistic_loss_np(pred, labels)))
+
+
+@dataclasses.dataclass(frozen=True)
+class PoissonLossEvaluator(Evaluator):
+    name: str = "POISSON_LOSS"
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        return float(np.sum(weights * (np.exp(pred) - labels * pred)))
+
+
+@dataclasses.dataclass(frozen=True)
+class SquaredLossEvaluator(Evaluator):
+    name: str = "SQUARED_LOSS"
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        return float(np.sum(weights * 0.5 * (pred - labels) ** 2))
+
+
+@dataclasses.dataclass(frozen=True)
+class SmoothedHingeLossEvaluator(Evaluator):
+    name: str = "SMOOTHED_HINGE_LOSS"
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        t = (2 * labels - 1) * pred
+        loss = np.where(t <= 0, 0.5 - t,
+                        np.where(t < 1, 0.5 * (1 - t) ** 2, 0.0))
+        return float(np.sum(weights * loss))
+
+
+class _ShardedEvaluator(Evaluator):
+    """Group rows by an id column; average the local metric over groups."""
+
+    id_type: str
+
+    def _groups(self, data):
+        from photon_ml_tpu.data.game_data import group_rows_by_code
+
+        return group_rows_by_code(data.id_columns[self.id_type].codes)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedAreaUnderROCCurveEvaluator(_ShardedEvaluator):
+    id_type: str = ""
+    name: str = ""
+
+    def __post_init__(self):
+        if not self.name:
+            object.__setattr__(self, "name", f"AUC:{self.id_type}")
+
+    @property
+    def higher_is_better(self) -> bool:
+        return True
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        if data is None:
+            raise ValueError("sharded evaluators need the dataset (id columns)")
+        vals = []
+        for rows in self._groups(data):
+            v = area_under_roc_curve(pred[rows], labels[rows], weights[rows])
+            if not np.isnan(v):
+                vals.append(v)
+        return float(np.mean(vals)) if vals else float("nan")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedPrecisionAtKEvaluator(_ShardedEvaluator):
+    k: int = 1
+    id_type: str = ""
+    name: str = ""
+
+    def __post_init__(self):
+        if not self.name:
+            object.__setattr__(self, "name", f"PRECISION@{self.k}:{self.id_type}")
+
+    @property
+    def higher_is_better(self) -> bool:
+        return True
+
+    def _evaluate(self, pred, labels, weights, data) -> float:
+        if data is None:
+            raise ValueError("sharded evaluators need the dataset (id columns)")
+        vals = []
+        for rows in self._groups(data):
+            if len(rows) == 0:
+                continue
+            top = rows[np.argsort(-pred[rows], kind="stable")[: self.k]]
+            vals.append(float((labels[top] >= 0.5).mean()))
+        return float(np.mean(vals)) if vals else float("nan")
+
+
+_PLAIN = {
+    "AUC": AreaUnderROCCurveEvaluator,
+    "RMSE": RMSEEvaluator,
+    "LOGISTIC_LOSS": LogisticLossEvaluator,
+    "POISSON_LOSS": PoissonLossEvaluator,
+    "SQUARED_LOSS": SquaredLossEvaluator,
+    "SMOOTHED_HINGE_LOSS": SmoothedHingeLossEvaluator,
+}
+
+
+def build_evaluator(spec: str) -> Evaluator:
+    """Parse an evaluator spec (reference: Evaluator.buildEvaluator +
+    EvaluatorType/ShardedEvaluatorType parsing):
+      'AUC' | 'RMSE' | '<LOSS>' | 'AUC:idType' | 'PRECISION@k:idType'
+    """
+    s = spec.strip()
+    up = s.upper()
+    if up in _PLAIN:
+        return _PLAIN[up]()
+    m = re.fullmatch(r"AUC:(\w+)", s, re.IGNORECASE)
+    if m:
+        return ShardedAreaUnderROCCurveEvaluator(id_type=m.group(1))
+    m = re.fullmatch(r"PRECISION@(\d+):(\w+)", s, re.IGNORECASE)
+    if m:
+        return ShardedPrecisionAtKEvaluator(k=int(m.group(1)),
+                                            id_type=m.group(2))
+    raise ValueError(f"unknown evaluator spec {spec!r}")

@@ -1,0 +1,29 @@
+"""Model hierarchy: GLMs, fixed/random effect models, GAME composite, MF."""
+
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import (
+    GeneralizedLinearModel,
+    LogisticRegressionModel,
+    LinearRegressionModel,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+    model_for_task,
+)
+from photon_ml_tpu.models.fixed_effect import FixedEffectModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
+from photon_ml_tpu.models.game_model import GameModel
+
+__all__ = [
+    "Coefficients",
+    "GeneralizedLinearModel",
+    "LogisticRegressionModel",
+    "LinearRegressionModel",
+    "PoissonRegressionModel",
+    "SmoothedHingeLossLinearSVMModel",
+    "model_for_task",
+    "FixedEffectModel",
+    "RandomEffectModel",
+    "MatrixFactorizationModel",
+    "GameModel",
+]

@@ -1,0 +1,54 @@
+"""Coefficients: means + optional variances
+(reference: ml/model/Coefficients.scala:33-155)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def num_features(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, features) -> Array:
+        """means . x (features: FeatureMatrix or array)."""
+        if hasattr(features, "matvec"):
+            return features.matvec(self.means)
+        return jnp.asarray(features) @ self.means
+
+    @property
+    def means_norm(self) -> Array:
+        return jnp.linalg.norm(self.means)
+
+    def is_close_to(self, other: "Coefficients", atol=1e-6) -> bool:
+        return bool(jnp.allclose(self.means, other.means, atol=atol))
+
+    @classmethod
+    def zeros(cls, d: int, dtype=jnp.float32) -> "Coefficients":
+        return cls(jnp.zeros((d,), dtype))
+
+    def to_numpy(self) -> tuple[np.ndarray, Optional[np.ndarray]]:
+        return (np.asarray(self.means),
+                None if self.variances is None else np.asarray(self.variances))
+
+    def tree_flatten(self):
+        if self.variances is None:
+            return (self.means,), ("no_var",)
+        return (self.means, self.variances), ("var",)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children) if aux[0] == "var" else cls(children[0])

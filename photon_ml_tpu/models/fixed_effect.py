@@ -1,0 +1,35 @@
+"""Fixed-effect model: one global GLM over a feature shard
+(reference: ml/model/FixedEffectModel.scala:29-105 — there the GLM is a Spark
+broadcast; here coefficients are device-resident and replicated by sharding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    glm: GeneralizedLinearModel
+    feature_shard_id: str
+
+    def score(self, data) -> Array:
+        """Dense score vector over all rows of a GameDataset."""
+        batch = data.fixed_effect_batch(self.feature_shard_id,
+                                        dtype=self.glm.coefficients.means.dtype)
+        return self.glm.compute_score(batch.features)
+
+    def score_numpy(self, data) -> np.ndarray:
+        mat = data.feature_shards[self.feature_shard_id]
+        means, _ = self.glm.coefficients.to_numpy()
+        return np.asarray(mat @ means).ravel()
+
+    def update_model(self, glm: GeneralizedLinearModel) -> "FixedEffectModel":
+        return FixedEffectModel(glm, self.feature_shard_id)

@@ -1,0 +1,48 @@
+"""GAME composite model: named sub-models with additive scores
+(reference: ml/model/GAMEModel.scala:33-171, DatumScoringModel interface)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Union
+
+import numpy as np
+
+from photon_ml_tpu.models.fixed_effect import FixedEffectModel
+from photon_ml_tpu.models.glm import model_for_task
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.types import TaskType
+
+SubModel = Union[FixedEffectModel, RandomEffectModel,
+                 MatrixFactorizationModel]
+
+
+@dataclasses.dataclass
+class GameModel:
+    models: Dict[str, SubModel]  # insertion order == coordinate order
+    task_type: TaskType
+
+    def get_model(self, name: str) -> SubModel:
+        return self.models[name]
+
+    def update_model(self, name: str, model: SubModel) -> "GameModel":
+        if name not in self.models:
+            raise KeyError(f"unknown coordinate {name!r}")
+        new = dict(self.models)
+        new[name] = model
+        return GameModel(new, self.task_type)
+
+    def score(self, data) -> np.ndarray:
+        """Additive score over all sub-models (host numpy; works on any
+        GameDataset, trained-on or fresh)."""
+        total = np.zeros(data.num_rows)
+        for m in self.models.values():
+            total += np.asarray(m.score_numpy(data))
+        return total
+
+    def predict_mean(self, data) -> np.ndarray:
+        """link^{-1}(score + offset) for the task type."""
+        glm_cls = model_for_task(self.task_type)
+        return np.asarray(
+            glm_cls.mean_of_score(self.score(data) + data.offsets))

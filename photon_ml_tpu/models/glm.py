@@ -1,0 +1,128 @@
+"""Generalized linear models
+(reference: ml/supervised/model/GeneralizedLinearModel.scala:30-143 and the
+concrete classes under ml/supervised/{classification,regression}/)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.constants import POSITIVE_RESPONSE_THRESHOLD
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.ops.losses import (
+    LogisticLoss,
+    PointwiseLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+)
+from photon_ml_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """score = coef . x + offset; mean = link^{-1}(score)."""
+
+    coefficients: Coefficients
+
+    task_type: ClassVar[TaskType]
+    loss: ClassVar[PointwiseLoss]
+
+    def compute_score(self, features) -> Array:
+        return self.coefficients.compute_score(features)
+
+    def compute_mean(self, features, offsets=0.0) -> Array:
+        return self.mean_of_score(self.compute_score(features) + offsets)
+
+    @staticmethod
+    def mean_of_score(score: Array) -> Array:
+        raise NotImplementedError
+
+    def update_coefficients(self, coefficients: Coefficients):
+        return type(self)(coefficients)
+
+    @property
+    def model_class_name(self) -> str:
+        return type(self).__name__
+
+    def tree_flatten(self):
+        return (self.coefficients,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+class LogisticRegressionModel(GeneralizedLinearModel):
+    """Also a binary classifier
+    (ml/supervised/classification/LogisticRegressionModel.scala)."""
+
+    task_type = TaskType.LOGISTIC_REGRESSION
+    loss = LogisticLoss
+
+    @staticmethod
+    def mean_of_score(score: Array) -> Array:
+        return jax.nn.sigmoid(score)
+
+    def predict_class(self, features, offsets=0.0,
+                      threshold=POSITIVE_RESPONSE_THRESHOLD) -> Array:
+        return (self.compute_mean(features, offsets) >= threshold).astype(
+            jnp.float32)
+
+
+@jax.tree_util.register_pytree_node_class
+class LinearRegressionModel(GeneralizedLinearModel):
+    task_type = TaskType.LINEAR_REGRESSION
+    loss = SquaredLoss
+
+    @staticmethod
+    def mean_of_score(score: Array) -> Array:
+        return score
+
+
+@jax.tree_util.register_pytree_node_class
+class PoissonRegressionModel(GeneralizedLinearModel):
+    task_type = TaskType.POISSON_REGRESSION
+    loss = PoissonLoss
+
+    @staticmethod
+    def mean_of_score(score: Array) -> Array:
+        return jnp.exp(score)
+
+
+@jax.tree_util.register_pytree_node_class
+class SmoothedHingeLossLinearSVMModel(GeneralizedLinearModel):
+    task_type = TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+    loss = SmoothedHingeLoss
+
+    @staticmethod
+    def mean_of_score(score: Array) -> Array:
+        return score  # raw margin; classification via threshold 0
+
+    def predict_class(self, features, offsets=0.0, threshold=0.0) -> Array:
+        return (self.compute_mean(features, offsets) >= threshold).astype(
+            jnp.float32)
+
+
+_MODEL_BY_TASK = {
+    m.task_type: m
+    for m in (LogisticRegressionModel, LinearRegressionModel,
+              PoissonRegressionModel, SmoothedHingeLossLinearSVMModel)
+}
+
+_MODEL_BY_NAME = {m.__name__: m for m in _MODEL_BY_TASK.values()}
+
+
+def model_for_task(task: TaskType) -> type[GeneralizedLinearModel]:
+    return _MODEL_BY_TASK[task]
+
+
+def model_class_by_name(name: str) -> type[GeneralizedLinearModel]:
+    return _MODEL_BY_NAME[name]

@@ -1,0 +1,63 @@
+"""Matrix factorization model: score = rowFactor(row_entity) . colFactor(col_entity)
+(reference: ml/model/MatrixFactorizationModel.scala:32-179)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixFactorizationModel:
+    row_effect_type: str  # id column naming the row entities (e.g. userId)
+    col_effect_type: str  # id column naming the col entities (e.g. songId)
+    row_factors: Array  # f[num_row_codes, k]
+    col_factors: Array  # f[num_col_codes, k]
+    row_vocabulary: np.ndarray
+    col_vocabulary: np.ndarray
+
+    @property
+    def num_latent_factors(self) -> int:
+        return self.row_factors.shape[-1]
+
+    def score(self, data) -> Array:
+        """Per-row dot of the two entities' factors; unseen entities -> 0."""
+        r = self._codes(data, self.row_effect_type, self.row_vocabulary)
+        c = self._codes(data, self.col_effect_type, self.col_vocabulary)
+        rf = jnp.vstack([self.row_factors,
+                         jnp.zeros((1, self.num_latent_factors),
+                                   self.row_factors.dtype)])
+        cf = jnp.vstack([self.col_factors,
+                         jnp.zeros((1, self.num_latent_factors),
+                                   self.col_factors.dtype)])
+        rr = jnp.where(r >= 0, r, rf.shape[0] - 1)
+        cc = jnp.where(c >= 0, c, cf.shape[0] - 1)
+        return jnp.sum(rf[rr] * cf[cc], axis=-1)
+
+    def score_numpy(self, data) -> np.ndarray:
+        return np.asarray(self.score(data))
+
+    def _codes(self, data, effect_type, vocab) -> Array:
+        col = data.id_columns[effect_type]
+        idx = {str(n): i for i, n in enumerate(vocab)}
+        mapped = np.asarray([idx.get(str(n), -1) for n in col.vocabulary],
+                            np.int32)
+        return jnp.asarray(mapped[col.codes])
+
+    @classmethod
+    def random(cls, row_effect_type, col_effect_type, row_vocab, col_vocab,
+               num_factors: int, seed: int = 0,
+               dtype=jnp.float32) -> "MatrixFactorizationModel":
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        scale = 1.0 / np.sqrt(num_factors)
+        return cls(
+            row_effect_type, col_effect_type,
+            jax.random.normal(k1, (len(row_vocab), num_factors), dtype) * scale,
+            jax.random.normal(k2, (len(col_vocab), num_factors), dtype) * scale,
+            np.asarray(row_vocab), np.asarray(col_vocab),
+        )

@@ -1,0 +1,118 @@
+"""Random-effect model: one small GLM per entity, stored as bucketed local
+coefficient blocks (reference: ml/model/RandomEffectModel.scala:33-168,
+RandomEffectModelInProjectedSpace.scala).
+
+The per-entity coefficients live in each entity's *local* feature subspace
+(the gather defined by the training blocks' feat_idx maps); conversion back
+to the global space is a host-side scatter used for persistence and for
+scoring datasets that were not bucketed with the same blocks (validation /
+test data, analogous to the reference's projected-space model conversion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    random_effect_type: str
+    feature_shard_id: str
+    local_coefs: List[Array]  # [E_b, d_pad] per bucket, local space
+    feat_idx: List[Array]  # [E_b, d_pad] per bucket, global col ids (-1 pad)
+    entity_codes: List[np.ndarray]  # [E_b] per bucket
+    vocabulary: np.ndarray  # entity name per code
+    num_global_features: int
+
+    @property
+    def num_entities(self) -> int:
+        return sum(len(c) for c in self.entity_codes)
+
+    def with_coefs(self, local_coefs: List[Array]) -> "RandomEffectModel":
+        return dataclasses.replace(self, local_coefs=list(local_coefs))
+
+    # -- global-space views (host) ----------------------------------------
+
+    def model_matrix(self) -> sp.csr_matrix:
+        """CSR [num_codes, d_global]: row c = entity c's global coefficients.
+
+        Codes never trained (or unseen at training) are zero rows — matching
+        the reference's join semantics where missing entities contribute no
+        score (RandomEffectModel.scala score join).
+        """
+        rows, cols, vals = [], [], []
+        for coefs, fidx, codes in zip(self.local_coefs, self.feat_idx,
+                                      self.entity_codes):
+            c = np.asarray(coefs)
+            f = np.asarray(fidx)
+            for i, code in enumerate(codes):
+                valid = f[i] >= 0
+                nz = valid & (c[i] != 0)
+                rows.extend([code] * int(nz.sum()))
+                cols.extend(f[i][nz].tolist())
+                vals.extend(c[i][nz].tolist())
+        n_codes = len(self.vocabulary)
+        return sp.csr_matrix(
+            (vals, (rows, cols)), shape=(n_codes, self.num_global_features))
+
+    def to_entity_dict(self) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+        """entity name -> (global col indices, values), for persistence."""
+        out = {}
+        m = self.model_matrix().tocsr()
+        for code, name in enumerate(self.vocabulary):
+            sl = slice(m.indptr[code], m.indptr[code + 1])
+            out[str(name)] = (m.indices[sl].copy(), m.data[sl].copy())
+        return out
+
+    # -- scoring -----------------------------------------------------------
+
+    def score_numpy(self, data) -> np.ndarray:
+        """Score arbitrary GameDataset rows: x_i . coef[entity(i)].
+
+        Rows whose entity is unknown to this model score 0.
+        """
+        mat = data.feature_shards[self.feature_shard_id].tocsr()
+        col = data.id_columns[self.random_effect_type]
+        m = self.model_matrix()
+
+        # Map this dataset's codes into the model's vocabulary.
+        code_map = self._vocab_lookup(col.vocabulary)
+        mapped = code_map[col.codes]  # -1 = unseen entity
+        valid = mapped >= 0
+        scores = np.zeros(data.num_rows)
+        if valid.any():
+            rows = np.flatnonzero(valid)
+            per_row_models = m[mapped[valid]]
+            scores[rows] = np.asarray(
+                mat[rows].multiply(per_row_models).sum(axis=1)).ravel()
+        return scores
+
+    def _vocab_lookup(self, other_vocab: np.ndarray) -> np.ndarray:
+        """For each name in other_vocab, this model's code or -1."""
+        idx = {str(n): i for i, n in enumerate(self.vocabulary)}
+        return np.asarray([idx.get(str(n), -1) for n in other_vocab],
+                          np.int64)
+
+    @classmethod
+    def zeros_like_dataset(cls, ds, dtype=jnp.float32) -> "RandomEffectModel":
+        """Zero model matching a RandomEffectDataset's block structure."""
+        return cls(
+            random_effect_type=ds.config.random_effect_type,
+            feature_shard_id=ds.config.feature_shard_id,
+            local_coefs=[jnp.zeros((b.num_entities, b.d_pad), dtype)
+                         for b in ds.blocks],
+            feat_idx=[b.feat_idx for b in ds.blocks],
+            entity_codes=list(ds.entity_codes),
+            vocabulary=ds.vocabulary,
+            num_global_features=ds.num_global_features,
+        )

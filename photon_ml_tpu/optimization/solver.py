@@ -1,0 +1,84 @@
+"""Solver dispatch: GLMOptimizationConfiguration -> the right minimizer.
+
+Mirrors the reference's optimizer selection
+(ml/optimization/OptimizerFactory.scala + GeneralizedLinearOptimizationProblem
+construction): TRON for twice-differentiable objectives, OWL-QN whenever the
+L1 weight is positive, L-BFGS otherwise. The L2 part always rides inside the
+objective; L1 is handled by OWL-QN's orthant machinery (same split as the
+reference, where L1 lives in Breeze's OWLQN and L2 in the objective mixins).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+
+from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    OptimizerType,
+)
+from photon_ml_tpu.optimization.convergence import OptimizerResult
+from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimization.owlqn import minimize_owlqn
+from photon_ml_tpu.optimization.tron import minimize_tron
+
+Array = jax.Array
+
+
+def solve_glm(
+    objective: GLMObjective,
+    batch: GLMBatch,
+    config: GLMOptimizationConfiguration,
+    coef0: Array,
+    lower_bounds: Optional[Array] = None,
+    upper_bounds: Optional[Array] = None,
+) -> OptimizerResult:
+    """One GLM solve. Pure: jit/vmap-safe given consistent static config."""
+    lam = config.regularization_weight
+    rc = config.regularization_context
+    l1 = rc.l1_weight(lam)
+    l2 = rc.l2_weight(lam)
+
+    fun = lambda c, b: objective.value(c, b, l2)
+
+    if config.optimizer_type == OptimizerType.TRON:
+        if not objective.loss.twice_differentiable:
+            raise ValueError(
+                f"TRON requires a twice-differentiable loss, got "
+                f"{objective.loss.name}")
+        if l1 > 0:
+            raise ValueError("TRON does not support L1 regularization")
+        return minimize_tron(
+            fun, coef0, args=(batch,), max_iter=config.max_iterations,
+            tol=config.tolerance, lower_bounds=lower_bounds,
+            upper_bounds=upper_bounds)
+    if l1 > 0:
+        if lower_bounds is not None or upper_bounds is not None:
+            raise ValueError(
+                "box constraints with L1 regularization are not supported")
+        return minimize_owlqn(
+            fun, coef0, args=(batch,), l1_weight=l1,
+            max_iter=config.max_iterations, tol=config.tolerance)
+    return minimize_lbfgs(
+        fun, coef0, args=(batch,), max_iter=config.max_iterations,
+        tol=config.tolerance, lower_bounds=lower_bounds,
+        upper_bounds=upper_bounds)
+
+
+def regularization_term(config: GLMOptimizationConfiguration, coefs) -> float:
+    """lambda-weighted penalty of a coefficient array (for the coordinate-
+    descent objective, CoordinateDescent.scala:203-212)."""
+    import jax.numpy as jnp
+
+    lam = config.regularization_weight
+    rc = config.regularization_context
+    l1 = rc.l1_weight(lam)
+    l2 = rc.l2_weight(lam)
+    out = 0.0
+    if l2 > 0:
+        out = out + 0.5 * l2 * float(jnp.sum(jnp.square(coefs)))
+    if l1 > 0:
+        out = out + l1 * float(jnp.sum(jnp.abs(coefs)))
+    return out

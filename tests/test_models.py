@@ -1,0 +1,99 @@
+"""Model-layer unit tests (reference: model/CoefficientsTest,
+GameModelTest, MatrixFactorizationModelTest patterns)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    MatrixFactorizationModel,
+    PoissonRegressionModel,
+    model_for_task,
+)
+from photon_ml_tpu.models.glm import model_class_by_name
+from photon_ml_tpu.types import TaskType
+
+
+def test_coefficients_score_and_zeros():
+    c = Coefficients(jnp.asarray([1.0, -2.0, 0.5]))
+    np.testing.assert_allclose(
+        c.compute_score(jnp.asarray([[1.0, 1.0, 2.0]])), [0.0])
+    z = Coefficients.zeros(3)
+    assert z.num_features == 3 and float(z.means_norm) == 0.0
+    assert not c.is_close_to(z)
+
+
+def test_glm_means_and_classes():
+    x = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+    c = Coefficients(jnp.asarray([2.0, -2.0]))
+    logit = LogisticRegressionModel(c)
+    np.testing.assert_allclose(
+        np.asarray(logit.compute_mean(x)),
+        [1 / (1 + np.exp(-2)), 1 / (1 + np.exp(2))], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(logit.predict_class(x)), [1.0, 0.0])
+    lin = LinearRegressionModel(c)
+    np.testing.assert_allclose(np.asarray(lin.compute_mean(x, 1.0)),
+                               [3.0, -1.0])
+    pois = PoissonRegressionModel(c)
+    np.testing.assert_allclose(np.asarray(pois.compute_mean(x)),
+                               [np.exp(2), np.exp(-2)], rtol=1e-6)
+    assert model_for_task(TaskType.LOGISTIC_REGRESSION) is \
+        LogisticRegressionModel
+    assert model_class_by_name("LogisticRegressionModel") is \
+        LogisticRegressionModel
+
+
+def _tiny_game_data():
+    x = np.asarray([[1.0, 2.0], [0.0, 1.0], [1.0, 0.0]])
+    return GameDataset.build(
+        responses=np.asarray([1.0, 0.0, 1.0]),
+        feature_shards={"s": sp.csr_matrix(x)},
+        ids={"userId": np.asarray(["a", "b", "a"]),
+             "itemId": np.asarray(["x", "x", "y"])},
+        offsets=np.asarray([0.1, 0.2, 0.3]),
+    )
+
+
+def test_fixed_effect_model_scores():
+    data = _tiny_game_data()
+    fe = FixedEffectModel(
+        LogisticRegressionModel(Coefficients(jnp.asarray([1.0, -1.0]))), "s")
+    np.testing.assert_allclose(np.asarray(fe.score(data)), [-1.0, -1.0, 1.0])
+    np.testing.assert_allclose(fe.score_numpy(data), [-1.0, -1.0, 1.0])
+
+
+def test_mf_model_scores_and_unseen_entities():
+    data = _tiny_game_data()
+    mf = MatrixFactorizationModel(
+        row_effect_type="userId", col_effect_type="itemId",
+        row_factors=jnp.asarray([[1.0, 0.0], [0.0, 2.0]]),  # a, b
+        col_factors=jnp.asarray([[3.0, 1.0]]),  # only "x"; "y" unseen
+        row_vocabulary=np.asarray(["a", "b"]),
+        col_vocabulary=np.asarray(["x"]))
+    # rows: (a,x)=3, (b,x)=2, (a,y)=0 (unseen item)
+    np.testing.assert_allclose(mf.score_numpy(data), [3.0, 2.0, 0.0])
+
+
+def test_game_model_additive_score_and_update():
+    data = _tiny_game_data()
+    fe = FixedEffectModel(
+        LogisticRegressionModel(Coefficients(jnp.asarray([1.0, -1.0]))), "s")
+    gm = GameModel({"fixed": fe}, TaskType.LOGISTIC_REGRESSION)
+    np.testing.assert_allclose(gm.score(data), [-1.0, -1.0, 1.0])
+    mean = gm.predict_mean(data)
+    np.testing.assert_allclose(
+        mean, 1 / (1 + np.exp(-(np.asarray([-1.0, -1.0, 1.0]) +
+                                data.offsets))))
+    fe2 = FixedEffectModel(
+        LogisticRegressionModel(Coefficients(jnp.asarray([0.0, 0.0]))), "s")
+    gm2 = gm.update_model("fixed", fe2)
+    np.testing.assert_allclose(gm2.score(data), 0.0)
+    with pytest.raises(KeyError):
+        gm.update_model("nope", fe2)
