@@ -1,0 +1,127 @@
+"""Benchmark: GAME coordinate-descent iteration throughput on the real chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The workload is the BASELINE.md north-star shape: GLMix (fixed effect +
+per-user random effects, logistic) — fixed-effect L-BFGS solve + vmapped
+per-entity solves + score exchange per coordinate-descent iteration.
+
+vs_baseline: speedup over the same training step executed with JAX on one
+host CPU core — the stand-in for the reference's Spark-local[*] CPU+BLAS
+execution (the reference publishes no numbers; BASELINE.md mandates
+self-measured baselines).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def build_problem(seed=7, n=200_000, d=200, n_users=5_000):
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.game_data import GameDataset
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (n, d)).astype(np.float32)
+    x[:, -1] = 1.0
+    w = rng.normal(0, 0.5, d)
+    users = rng.integers(0, n_users, n)
+    bias = rng.normal(0, 1.0, n_users)
+    z = x @ w + bias[users]
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float)
+    return GameDataset.build(
+        responses=y,
+        feature_shards={"global": sp.csr_matrix(x),
+                        "user": sp.csr_matrix(np.ones((n, 1)))},
+        ids={"userId": users.astype(str)})
+
+
+def run_cd(data, num_iterations):
+    """Returns (steady-state seconds per CD iteration, final objective)."""
+    import jax
+
+    from photon_ml_tpu.algorithm import (
+        CoordinateDescent,
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+    )
+    from photon_ml_tpu.data.random_effect import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+    from photon_ml_tpu.types import TaskType
+
+    re_data = build_random_effect_dataset(
+        data, RandomEffectDataConfiguration("userId", "user"),
+        intercept_col=0)
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            name="fixed", data=data, feature_shard_id="global",
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            config=GLMOptimizationConfiguration(
+                max_iterations=50, tolerance=1e-7, regularization_weight=1.0)),
+        "perUser": RandomEffectCoordinate(
+            name="perUser", dataset=re_data,
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            config=GLMOptimizationConfiguration(
+                max_iterations=20, tolerance=1e-6,
+                regularization_weight=1.0)),
+    }
+    cd = CoordinateDescent(coords, TaskType.LOGISTIC_REGRESSION)
+    # Warm-up iteration compiles everything.
+    cd.run(num_iterations=1)
+    t0 = time.perf_counter()
+    res = cd.run(num_iterations=num_iterations)
+    per_iter = (time.perf_counter() - t0) / num_iterations
+    return per_iter, res.objective_history[-1]
+
+
+def main():
+    if os.environ.get("PHOTON_BENCH_CPU_BASELINE") == "1":
+        # Subprocess mode: measure the CPU baseline (1 iteration). The env
+        # var alone can be overridden by platform sitecustomize hooks —
+        # force the platform through jax.config before backend init.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        data = build_problem()
+        per_iter, _ = run_cd(data, num_iterations=1)
+        print(json.dumps({"cpu_seconds_per_iter": per_iter}))
+        return
+
+    data = build_problem()
+    per_iter, objective = run_cd(data, num_iterations=3)
+
+    baseline_s = None
+    try:
+        env = dict(os.environ, PHOTON_BENCH_CPU_BASELINE="1",
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=3600, check=True)
+        baseline_s = json.loads(out.stdout.strip().splitlines()[-1])[
+            "cpu_seconds_per_iter"]
+    except Exception as e:  # noqa: BLE001 - baseline is best-effort
+        print(f"# cpu baseline failed: {e}", file=sys.stderr)
+
+    result = {
+        "metric": "game_glmix_cd_iters_per_sec",
+        "value": round(1.0 / per_iter, 4),
+        "unit": "iters/sec (200k rows, d=200 fixed + 5k-user random effects)",
+        "vs_baseline": (round(baseline_s / per_iter, 2)
+                        if baseline_s else None),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ objective computations as needed).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional, Tuple
 
 import jax
@@ -72,10 +73,15 @@ class FixedEffectCoordinate(Coordinate):
     upper_bounds: Optional[Array] = None
     normalization: Optional[object] = None  # NormalizationContext
     dtype: object = jnp.float32
+    mesh: Optional[object] = None  # jax.sharding.Mesh: shard rows over it
 
     def __post_init__(self):
         self._batch = self.data.fixed_effect_batch(
             self.feature_shard_id, dtype=self.dtype)
+        if self.mesh is not None:
+            from photon_ml_tpu.parallel import shard_batch
+
+            self._batch = shard_batch(self._batch, self.mesh)
         self._objective = GLMObjective(
             loss_for_task(self.task_type), self.normalization)
 
@@ -92,6 +98,14 @@ class FixedEffectCoordinate(Coordinate):
     ) -> Tuple[FixedEffectModel, object]:
         batch = self._batch
         if residual_scores is not None:
+            # The batch may be row-padded for sharding; pad the residual with
+            # zeros to match (padding rows have weight 0, so the value added
+            # there is irrelevant).
+            pad = batch.num_rows - residual_scores.shape[0]
+            if pad:
+                residual_scores = jnp.concatenate(
+                    [residual_scores,
+                     jnp.zeros((pad,), residual_scores.dtype)])
             batch = batch.with_offsets(
                 batch.offsets + residual_scores.astype(batch.offsets.dtype))
         weights = down_sample_weights(
@@ -117,8 +131,11 @@ class FixedEffectCoordinate(Coordinate):
 
     def score(self, model: FixedEffectModel) -> Array:
         # Original-space coefficients against raw features — consistent with
-        # host-side scoring (FixedEffectModel.score_numpy).
-        return model.glm.compute_score(self._batch.features)
+        # host-side scoring (FixedEffectModel.score_numpy). The batch may be
+        # row-padded for sharding; scores are truncated to the true row count
+        # so they align with other coordinates' score vectors.
+        return model.glm.compute_score(
+            self._batch.features)[: self.data.num_rows]
 
     def regularization_term(self, model: FixedEffectModel) -> float:
         # The penalty applies in the optimization (normalized) space.
@@ -137,8 +154,23 @@ class RandomEffectCoordinate(Coordinate):
     dataset: RandomEffectDataset
     task_type: TaskType
     config: GLMOptimizationConfiguration
+    mesh: Optional[object] = None  # jax.sharding.Mesh: shard entities over it
 
     def __post_init__(self):
+        if self.mesh is not None:
+            from photon_ml_tpu.parallel import shard_block
+
+            self.dataset = dataclasses.replace(
+                self.dataset,
+                blocks=[shard_block(b, self.mesh,
+                                    sentinel_row=self.dataset.n_rows)
+                        for b in self.dataset.blocks],
+                passive_blocks=[
+                    None if b is None else
+                    shard_block(b, self.mesh,
+                                sentinel_row=self.dataset.n_rows)
+                    for b in self.dataset.passive_blocks],
+            )
         self._objective = GLMObjective(loss_for_task(self.task_type))
 
     def initialize_model(self) -> RandomEffectModel:
@@ -157,7 +189,7 @@ class RandomEffectCoordinate(Coordinate):
             extra = _gather_residual(residual_scores, block,
                                      self.dataset.n_rows)
             result = _solve_block(
-                self._objective, block, extra, coefs, self.config)
+                self._objective, self.config, block, extra, coefs)
             new_coefs.append(result.x)
             trackers.append(result)
         return model.with_coefs(new_coefs), trackers
@@ -194,11 +226,15 @@ def _gather_residual(residual_scores: Optional[Array], block: EntityBlock,
     return ext[block.row_ids]
 
 
+@functools.partial(jax.jit, static_argnames=("objective", "config"))
 def _solve_block(
-    objective: GLMObjective, block: EntityBlock, extra_offsets, coefs0,
-    config: GLMOptimizationConfiguration,
+    objective: GLMObjective, config: GLMOptimizationConfiguration,
+    block: EntityBlock, extra_offsets, coefs0,
 ):
-    """One vmapped solve over the bucket's entity axis."""
+    """One vmapped solve over the bucket's entity axis, jitted so the whole
+    batched solve (trace included) is cached across coordinate-descent
+    iterations. ``objective`` hashes by identity and ``config`` by value —
+    both stable for a persistent coordinate."""
     offsets = block.offsets if extra_offsets is None else \
         block.offsets + extra_offsets.astype(block.offsets.dtype)
 

@@ -80,9 +80,13 @@ def make_batch(features, labels, offsets=None, weights=None) -> GLMBatch:
     return GLMBatch(features, labels, jnp.asarray(offsets), jnp.asarray(weights))
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class GLMObjective:
     """value(coef) = sum_i w_i * l(margin_i, y_i) + l2/2 ||coef||^2.
+
+    NOTE eq=False: objectives hash by identity so that bound methods
+    (``objective.value``) are stable jit static arguments — construct ONE
+    objective per coordinate/problem and reuse it, or every solve recompiles.
 
     margin_i = eff . x_i + offset_i - eff . shift, with
     eff = coef .* normalization.factors (see data/normalization.py).

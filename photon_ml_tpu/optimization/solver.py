@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Callable, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.optimization.config import (
@@ -41,7 +42,12 @@ def solve_glm(
     l1 = rc.l1_weight(lam)
     l2 = rc.l2_weight(lam)
 
-    fun = lambda c, b: objective.value(c, b, l2)
+    # jit-cache discipline: ``objective.value`` is the static fun (stable for
+    # a persistent objective instance); the batch AND the l2 weight are
+    # traced args, so λ-grid sweeps and repeated coordinate updates reuse one
+    # compiled solver.
+    fun = objective.value
+    l2_arr = jnp.asarray(l2, coef0.dtype)
 
     if config.optimizer_type == OptimizerType.TRON:
         if not objective.loss.twice_differentiable:
@@ -51,7 +57,7 @@ def solve_glm(
         if l1 > 0:
             raise ValueError("TRON does not support L1 regularization")
         return minimize_tron(
-            fun, coef0, args=(batch,), max_iter=config.max_iterations,
+            fun, coef0, args=(batch, l2_arr), max_iter=config.max_iterations,
             tol=config.tolerance, lower_bounds=lower_bounds,
             upper_bounds=upper_bounds)
     if l1 > 0:
@@ -59,10 +65,10 @@ def solve_glm(
             raise ValueError(
                 "box constraints with L1 regularization are not supported")
         return minimize_owlqn(
-            fun, coef0, args=(batch,), l1_weight=l1,
+            fun, coef0, args=(batch, l2_arr), l1_weight=l1,
             max_iter=config.max_iterations, tol=config.tolerance)
     return minimize_lbfgs(
-        fun, coef0, args=(batch,), max_iter=config.max_iterations,
+        fun, coef0, args=(batch, l2_arr), max_iter=config.max_iterations,
         tol=config.tolerance, lower_bounds=lower_bounds,
         upper_bounds=upper_bounds)
 
@@ -70,8 +76,6 @@ def solve_glm(
 def regularization_term(config: GLMOptimizationConfiguration, coefs) -> float:
     """lambda-weighted penalty of a coefficient array (for the coordinate-
     descent objective, CoordinateDescent.scala:203-212)."""
-    import jax.numpy as jnp
-
     lam = config.regularization_weight
     rc = config.regularization_context
     l1 = rc.l1_weight(lam)

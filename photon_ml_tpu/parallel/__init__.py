@@ -1,0 +1,10 @@
+"""Device-mesh parallelism: sharding specs and distributed training helpers."""
+
+from photon_ml_tpu.parallel.distributed import (
+    make_mesh,
+    shard_batch,
+    shard_block,
+    replicate,
+)
+
+__all__ = ["make_mesh", "shard_batch", "shard_block", "replicate"]
