@@ -57,7 +57,11 @@ def run_cd(data, num_iterations):
         RandomEffectDataConfiguration,
         build_random_effect_dataset,
     )
-    from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+        RegularizationType,
+    )
     from photon_ml_tpu.types import TaskType
 
     re_data = build_random_effect_dataset(
@@ -68,13 +72,14 @@ def run_cd(data, num_iterations):
             name="fixed", data=data, feature_shard_id="global",
             task_type=TaskType.LOGISTIC_REGRESSION,
             config=GLMOptimizationConfiguration(
-                max_iterations=50, tolerance=1e-7, regularization_weight=1.0)),
+                max_iterations=50, tolerance=1e-7, regularization_weight=1.0,
+                regularization_context=RegularizationContext(RegularizationType.L2))),
         "perUser": RandomEffectCoordinate(
             name="perUser", dataset=re_data,
             task_type=TaskType.LOGISTIC_REGRESSION,
             config=GLMOptimizationConfiguration(
-                max_iterations=20, tolerance=1e-6,
-                regularization_weight=1.0)),
+                max_iterations=20, tolerance=1e-6, regularization_weight=1.0,
+                regularization_context=RegularizationContext(RegularizationType.L2))),
     }
     cd = CoordinateDescent(coords, TaskType.LOGISTIC_REGRESSION)
     # Warm-up iteration compiles everything.

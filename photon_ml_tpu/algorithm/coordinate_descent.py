@@ -138,11 +138,12 @@ class CoordinateDescent:
 
     def _training_objective(self, loss, total_scores: Array, models) -> float:
         labels, offsets, weights = self._training_rows(total_scores.dtype)
-        data_term = float(jnp.sum(
-            weights * loss.loss(total_scores + offsets, labels)))
+        data_term = jnp.sum(
+            weights * loss.loss(total_scores + offsets, labels))
         reg = sum(self.coordinates[n].regularization_term(models[n])
                   for n in self.coordinates)
-        return data_term + reg
+        # Single host sync for the whole objective (device scalars only).
+        return float(data_term + reg)
 
     def _training_rows(self, dtype) -> Tuple[Array, Array, Array]:
         """(labels, offsets, weights) aligned with the global row order,

@@ -1,0 +1,1 @@
+"""Command-line drivers (reference: ml/Driver.scala, ml/cli/game/)."""

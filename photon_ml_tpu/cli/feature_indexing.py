@@ -1,0 +1,47 @@
+"""Feature indexing job (reference: ml/FeatureIndexingJob.scala:59-350):
+scan training Avro, build a name⊕term -> index map per feature shard, persist.
+The reference writes partitioned PalDB stores; here a JSON map per shard is
+sufficient (SURVEY §2.9)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from photon_ml_tpu.data.avro_reader import build_index_map
+from photon_ml_tpu.utils.logging_utils import setup_photon_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-feature-indexing-job")
+    p.add_argument("--data-path", required=True)
+    p.add_argument("--partition-num", type=int, default=1,
+                   help="accepted for reference-CLI compatibility; the JSON "
+                        "store is single-partition")
+    p.add_argument("--add-intercept", default="true",
+                   choices=["true", "false"])
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--shard-name", default="global")
+    return p
+
+
+def run(argv=None) -> Path:
+    args = build_parser().parse_args(argv)
+    out_dir = Path(args.output_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    logger = setup_photon_logger(out_dir)
+    imap = build_index_map(args.data_path,
+                           add_intercept=args.add_intercept == "true")
+    out = out_dir / f"{args.shard_name}.json"
+    imap.save(out)
+    logger.info("indexed %d features -> %s", len(imap), out)
+    return out
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""GAME scoring driver (reference: ml/cli/game/scoring/Driver.scala:36-265):
+load a saved GAME model, score a dataset, write ScoringResultAvro, optionally
+evaluate."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+from photon_ml_tpu.data.avro_reader import read_game_dataset
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.evaluation import build_evaluator
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import write_container
+from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.utils.logging_utils import setup_photon_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="photon-game-scoring-driver")
+    p.add_argument("--input-dirs", required=True)
+    p.add_argument("--game-model-input-dir", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-index-dir", default=None,
+                   help="directory of <shard>.json index maps (defaults to "
+                        "<model-dir>/feature-indexes)")
+    p.add_argument("--evaluators", default=None)
+    p.add_argument("--id-types", default=None)
+    return p
+
+
+def run(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    out_dir = Path(args.output_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    logger = setup_photon_logger(out_dir)
+    t0 = time.perf_counter()
+
+    model_dir = Path(args.game_model_input_dir)
+    index_dir = Path(args.feature_index_dir) if args.feature_index_dir else \
+        model_dir / "feature-indexes"
+    shard_maps = {
+        f.stem: IndexMap.load(f) for f in sorted(index_dir.glob("*.json"))}
+    if not shard_maps:
+        raise FileNotFoundError(f"no feature index maps under {index_dir}")
+    model = load_game_model(model_dir, shard_maps)
+
+    meta = json.loads((model_dir / "model-metadata.json").read_text())
+    id_types = sorted(
+        {c["randomEffectType"] for c in meta["coordinates"]
+         if c["kind"] == "random"} |
+        {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
+
+    data, _ = read_game_dataset(args.input_dirs, id_types=id_types,
+                                feature_shard_maps=shard_maps)
+    scores = model.score(data)
+    logger.info("scored %d rows", data.num_rows)
+
+    uids = data.uids if data.uids is not None else \
+        np.asarray([str(i) for i in range(data.num_rows)])
+    scores_dir = out_dir / "scores"
+    scores_dir.mkdir(exist_ok=True)
+    write_container(
+        scores_dir / "part-00000.avro", schemas.SCORING_RESULT,
+        [{"uid": str(u), "predictionScore": float(s + o),
+          "label": float(l), "metadataMap": None}
+         for u, s, o, l in zip(uids, scores, data.offsets, data.responses)])
+
+    metrics = {}
+    for spec in (args.evaluators or "").split(","):
+        if spec.strip():
+            ev = build_evaluator(spec.strip())
+            metrics[ev.name] = ev.evaluate_dataset(scores, data)
+    summary = {
+        "numRows": int(data.num_rows),
+        "metrics": metrics,
+        "totalSeconds": time.perf_counter() - t0,
+    }
+    (out_dir / "metrics.json").write_text(json.dumps(summary, indent=2))
+    logger.info("scoring done: %s", metrics)
+    return summary
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

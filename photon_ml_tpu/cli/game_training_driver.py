@@ -1,0 +1,196 @@
+"""GAME training driver (reference: ml/cli/game/training/Driver.scala:43-298,
+params from ml/estimators/GameParams.scala:40-427).
+
+Coordinate mini-DSLs preserved from the reference:
+  --fixed-effect-data-configurations   name:featureShardId
+  --random-effect-data-configurations  name:reType,shardId,numPartitions,
+                                       activeBound,passiveBound,ratio[,proj]
+  --fixed-effect-optimization-configurations / --random-effect-...:
+                                       name:maxIter,tol,λ,rate,optimizer,reg
+                                       (| separates grid points)
+  --updating-sequence                  comma-separated coordinate names
+Outputs: <output-dir>/best/ (saved GAME model), metrics.json, log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from photon_ml_tpu.data.avro_reader import read_game_dataset
+from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
+from photon_ml_tpu.estimators.game_estimator import (
+    FixedEffectSpec,
+    GameEstimator,
+    RandomEffectSpec,
+)
+from photon_ml_tpu.evaluation import build_evaluator
+from photon_ml_tpu.io.model_io import save_game_model
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.logging_utils import setup_photon_logger
+
+
+def _parse_named(values, what):
+    out = {}
+    for item in values or []:
+        name, _, rest = item.partition(":")
+        if not rest:
+            raise ValueError(f"bad {what} {item!r}: expected 'name:...'")
+        out[name.strip()] = rest.strip()
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-game-training-driver",
+        description="Train GAME models (fixed + random effects)")
+    p.add_argument("--train-input-dirs", required=True)
+    p.add_argument("--validate-input-dirs", default=None)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task-type", required=True,
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--fixed-effect-data-configurations", nargs="*",
+                   default=[], metavar="name:featureShardId")
+    p.add_argument("--fixed-effect-optimization-configurations", nargs="*",
+                   default=[], metavar="name:optConfig[|optConfig...]")
+    p.add_argument("--random-effect-data-configurations", nargs="*",
+                   default=[], metavar="name:reDataConfig")
+    p.add_argument("--random-effect-optimization-configurations", nargs="*",
+                   default=[], metavar="name:optConfig[|optConfig...]")
+    p.add_argument("--updating-sequence", required=True,
+                   help="comma-separated coordinate order")
+    p.add_argument("--num-iterations", type=int, default=1)
+    p.add_argument("--evaluators", default=None,
+                   help="comma-separated evaluator specs (first selects)")
+    p.add_argument("--id-types", default=None,
+                   help="extra entity id columns to read from metadataMap "
+                        "(defaults to the random-effect types)")
+    p.add_argument("--save-all-models", default="false",
+                   choices=["true", "false"],
+                   help="model-output-mode ALL vs BEST")
+    return p
+
+
+def run(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    out_dir = Path(args.output_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    logger = setup_photon_logger(out_dir)
+    task = TaskType(args.task_type)
+    t0 = time.perf_counter()
+
+    fe_data = _parse_named(args.fixed_effect_data_configurations,
+                           "fixed-effect data config")
+    fe_opt = _parse_named(args.fixed_effect_optimization_configurations,
+                          "fixed-effect optimization config")
+    re_data = {
+        name: RandomEffectDataConfiguration.parse(cfg)
+        for name, cfg in _parse_named(
+            args.random_effect_data_configurations,
+            "random-effect data config").items()}
+    re_opt = _parse_named(args.random_effect_optimization_configurations,
+                          "random-effect optimization config")
+
+    sequence = [s.strip() for s in args.updating_sequence.split(",")]
+    for name in sequence:
+        if name not in fe_data and name not in re_data:
+            raise ValueError(
+                f"updating-sequence entry {name!r} has no data configuration")
+
+    id_types = sorted(
+        {c.random_effect_type for c in re_data.values()} |
+        {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
+
+    logger.info("reading training data from %s", args.train_input_dirs)
+    data, shard_maps = read_game_dataset(args.train_input_dirs,
+                                         id_types=id_types)
+    validation = None
+    if args.validate_input_dirs:
+        validation, _ = read_game_dataset(
+            args.validate_input_dirs, id_types=id_types,
+            feature_shard_maps=shard_maps)
+
+    def parse_grid(s: str):
+        return [GLMOptimizationConfiguration.parse(part)
+                for part in s.split("|")]
+
+    specs = []
+    for name in sequence:
+        if name in fe_data:
+            shard = fe_data[name]
+            if shard not in shard_maps:
+                raise ValueError(
+                    f"fixed-effect coordinate {name!r} references unknown "
+                    f"feature shard {shard!r} (have {sorted(shard_maps)})")
+            specs.append(FixedEffectSpec(
+                name=name, feature_shard_id=shard,
+                configs=parse_grid(fe_opt[name])))
+        else:
+            cfg = re_data[name]
+            if cfg.feature_shard_id not in shard_maps:
+                raise ValueError(
+                    f"random-effect coordinate {name!r} references unknown "
+                    f"feature shard {cfg.feature_shard_id!r}")
+            imap = shard_maps[cfg.feature_shard_id]
+            specs.append(RandomEffectSpec(
+                name=name, data_config=cfg, configs=parse_grid(re_opt[name]),
+                intercept_col=(imap.intercept_index
+                               if imap.intercept_index >= 0 else None)))
+
+    evaluators = [build_evaluator(s.strip())
+                  for s in (args.evaluators or "").split(",") if s.strip()]
+
+    estimator = GameEstimator(
+        task_type=task, coordinate_specs=specs,
+        num_iterations=args.num_iterations,
+        validation_evaluators=evaluators)
+    results = estimator.fit(data, validation_data=validation)
+    best_configs, best_result = estimator.select_best(results)
+
+    save_game_model(
+        out_dir / "best", best_result.best_model, shard_maps,
+        metadata_extras={
+            "optimizationConfigurations": {
+                k: v.to_json() for k, v in best_configs.items()},
+            "updatingSequence": sequence,
+            "numIterations": args.num_iterations,
+        })
+    # Persist the feature index maps next to the model so the scoring driver
+    # can decode features identically (the reference ships PalDB stores).
+    index_dir = out_dir / "best" / "feature-indexes"
+    index_dir.mkdir(parents=True, exist_ok=True)
+    for shard, imap in shard_maps.items():
+        imap.save(index_dir / f"{shard}.json")
+    if args.save_all_models == "true":
+        for i, (configs, result) in enumerate(results):
+            save_game_model(
+                out_dir / "all" / str(i), result.model, shard_maps,
+                metadata_extras={
+                    "optimizationConfigurations": {
+                        k: v.to_json() for k, v in configs.items()}})
+
+    summary = {
+        "taskType": task.value,
+        "updatingSequence": sequence,
+        "numCombos": len(results),
+        "bestConfigs": {k: v.to_string() for k, v in best_configs.items()},
+        "objectiveHistory": best_result.objective_history,
+        "validationHistory": best_result.validation_history,
+        "coordinateSeconds": best_result.timings,
+        "totalSeconds": time.perf_counter() - t0,
+    }
+    (out_dir / "metrics.json").write_text(json.dumps(summary, indent=2))
+    logger.info("GAME training done in %.1fs", summary["totalSeconds"])
+    return summary
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+"""GLM training driver — the TPU counterpart of the reference's
+spark-submit entry (ml/Driver.scala:70-638, flags from ml/Params.scala:42-203
+/ ml/OptionNames.scala; defaults preserved: 80 iterations, λ=[10], LBFGS,
+L2, tolerance 1e-6, intercept on).
+
+Staged pipeline: INIT -> PREPROCESSED -> TRAINED -> VALIDATED -> DIAGNOSED.
+Outputs under --output-directory:
+  log-message.txt, best-model/{model.txt,model.avro},
+  all-models/<λ>/..., validation-metrics.json, summary.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+from photon_ml_tpu.data.avro_reader import read_labeled_points
+from photon_ml_tpu.data.index_map import IdentityIndexMap, IndexMap
+from photon_ml_tpu.data.libsvm import read_libsvm
+from photon_ml_tpu.data.normalization import build_normalization_context
+from photon_ml_tpu.data.stats import BasicStatisticalSummary
+from photon_ml_tpu.data.validators import validate_data
+from photon_ml_tpu.estimators.model_selection import select_best_model
+from photon_ml_tpu.estimators.model_training import train_glm_models
+from photon_ml_tpu.evaluation.validation import evaluate_glm
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import write_container
+from photon_ml_tpu.io.model_io import glm_to_avro_record, write_text_model
+from photon_ml_tpu.optimization.config import (
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    constraint_arrays,
+    parse_constraint_string,
+)
+from photon_ml_tpu.types import DataValidationType, NormalizationType, TaskType
+from photon_ml_tpu.utils import (
+    PhotonOptimizationLogEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.utils.events import EventEmitter
+from photon_ml_tpu.utils.logging_utils import setup_photon_logger
+from photon_ml_tpu.utils.timer import PhaseTimer
+
+STAGES = ["INIT", "PREPROCESSED", "TRAINED", "VALIDATED"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-glm-driver",
+        description="Train GLMs over a regularization-weight grid "
+                    "(reference flag names from ml/OptionNames.scala)")
+    p.add_argument("--training-data-directory", required=True)
+    p.add_argument("--validating-data-directory", default=None)
+    p.add_argument("--output-directory", required=True)
+    p.add_argument("--task", required=True,
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--format", default="AVRO", choices=["AVRO", "LIBSVM"])
+    p.add_argument("--max-num-iterations", type=int, default=80)
+    p.add_argument("--regularization-weights", default="10",
+                   help="comma-separated λ grid")
+    p.add_argument("--regularization-type", default="L2",
+                   choices=[t.value for t in RegularizationType])
+    p.add_argument("--elastic-net-alpha", type=float, default=None)
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[t.value for t in OptimizerType])
+    p.add_argument("--tolerance", type=float, default=1e-6)
+    p.add_argument("--intercept", default="true",
+                   choices=["true", "false"], help="add intercept term")
+    p.add_argument("--normalization-type", default="NONE",
+                   choices=[t.value for t in NormalizationType])
+    p.add_argument("--coefficient-box-constraints", default=None,
+                   help="JSON constraint string (GLMSuite format)")
+    p.add_argument("--validate-data", default="VALIDATE_FULL",
+                   choices=[t.value for t in DataValidationType])
+    p.add_argument("--compute-variance", default="false",
+                   choices=["true", "false"])
+    p.add_argument("--warm-start", default="true", choices=["true", "false"])
+    p.add_argument("--job-name", default="photon-ml-tpu")
+    p.add_argument("--event-listeners", default=None,
+                   help="comma-separated listener class paths")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64"])
+    return p
+
+
+def _load(path: str, fmt: str, add_intercept: bool, task: TaskType,
+          index_map: IndexMap | None = None):
+    """index_map: pass the training map when loading validation data so
+    columns decode identically (the reference shares one feature index)."""
+    if fmt == "AVRO":
+        mat, y, off, w, _, imap = read_labeled_points(
+            path, index_map=index_map, add_intercept=add_intercept)
+        return mat, y, off, w, imap
+    files = sorted(Path(path).glob("*")) if Path(path).is_dir() else \
+        [Path(path)]
+    mats, ys = [], []
+    for f in files:
+        if f.is_file():
+            m, y = read_libsvm(
+                f, add_intercept=False,
+                map_negative_labels=task.is_classification)
+            mats.append(m)
+            ys.append(y)
+    import scipy.sparse as sp
+
+    d = max(m.shape[1] for m in mats)
+    mats = [sp.csr_matrix((m.data, m.indices, m.indptr), shape=(m.shape[0], d))
+            for m in mats]
+    mat = sp.vstack(mats, format="csr")
+    if add_intercept:
+        mat = sp.hstack([mat, np.ones((mat.shape[0], 1))], format="csr")
+    y = np.concatenate(ys)
+    imap = IdentityIndexMap(mat.shape[1], intercept_last=add_intercept)
+    return mat, y, np.zeros(len(y)), np.ones(len(y)), imap
+
+
+def run(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    out_dir = Path(args.output_directory)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    logger = setup_photon_logger(out_dir)
+    task = TaskType(args.task)
+    add_intercept = args.intercept == "true"
+    timer = PhaseTimer()
+    stages = ["INIT"]
+
+    emitter = EventEmitter()
+    for cp in (args.event_listeners or "").split(","):
+        if cp.strip():
+            emitter.register_listener_by_name(cp.strip())
+    emitter.send_event(TrainingStartEvent(args.job_name))
+    t_start = time.perf_counter()
+
+    import jax.numpy as jnp
+
+    dtype = jnp.float64 if args.dtype == "float64" else jnp.float32
+
+    # ---- preprocess ------------------------------------------------------
+    with timer.time("preprocess"):
+        mat, y, off, w, imap = _load(
+            args.training_data_directory, args.format, add_intercept, task)
+        logger.info("loaded %d rows x %d features", *mat.shape)
+        validate_data(task, mat, y, off, w,
+                      DataValidationType(args.validate_data))
+        norm = None
+        if args.normalization_type != "NONE":
+            summary = BasicStatisticalSummary.compute(mat)
+            norm = build_normalization_context(
+                args.normalization_type, summary,
+                intercept_id=imap.intercept_index)
+        lb = ub = None
+        if args.coefficient_box_constraints:
+            cmap = parse_constraint_string(
+                args.coefficient_box_constraints, imap)
+            lb, ub = constraint_arrays(cmap, len(imap),
+                                       imap.intercept_index)
+    stages.append("PREPROCESSED")
+
+    # ---- train -----------------------------------------------------------
+    lambdas = [float(s) for s in args.regularization_weights.split(",")]
+    reg_ctx = RegularizationContext(
+        RegularizationType(args.regularization_type),
+        args.elastic_net_alpha)
+    with timer.time("train"):
+        trained = train_glm_models(
+            mat, y, task,
+            regularization_weights=lambdas,
+            regularization_context=reg_ctx,
+            optimizer_type=OptimizerType(args.optimizer),
+            max_iterations=args.max_num_iterations,
+            tolerance=args.tolerance,
+            offsets=off, weights=w, normalization=norm,
+            lower_bounds=lb, upper_bounds=ub,
+            warm_start=args.warm_start == "true",
+            compute_variances=args.compute_variance == "true",
+            dtype=dtype)
+    stages.append("TRAINED")
+    for t in trained:
+        emitter.send_event(PhotonOptimizationLogEvent(
+            t.reg_weight, int(t.result.iterations),
+            t.result.reason_enum().summary, float(t.result.value)))
+
+    # ---- validate + select ----------------------------------------------
+    best_lambda = lambdas[0]
+    metrics_by_lambda = {}
+    if args.validating_data_directory:
+        with timer.time("validate"):
+            vmat, vy, voff, vw, _ = _load(
+                args.validating_data_directory, args.format, add_intercept,
+                task, index_map=imap if args.format == "AVRO" else None)
+            if vmat.shape[1] != mat.shape[1]:
+                raise ValueError(
+                    f"validation feature dim {vmat.shape[1]} != "
+                    f"training {mat.shape[1]}")
+            scored = {}
+            for t in trained:
+                means, _ = t.model.coefficients.to_numpy()
+                scored[t.reg_weight] = np.asarray(vmat @ means).ravel()
+            best_lambda, _ = select_best_model(task, scored, vy, voff, vw)
+            for t in trained:
+                metrics_by_lambda[t.reg_weight] = evaluate_glm(
+                    task, scored[t.reg_weight], vy, voff, vw,
+                    num_coefficients=mat.shape[1])
+            (out_dir / "validation-metrics.json").write_text(
+                json.dumps({str(k): v for k, v in metrics_by_lambda.items()},
+                           indent=2))
+        stages.append("VALIDATED")
+
+    # ---- write models ----------------------------------------------------
+    with timer.time("write"):
+        by_lambda = {t.reg_weight: t for t in trained}
+        best = by_lambda[best_lambda]
+        best_dir = out_dir / "best-model"
+        best_dir.mkdir(exist_ok=True)
+        write_text_model(best_dir / "model.txt", best.model, imap,
+                         best.reg_weight)
+        write_container(best_dir / "model.avro",
+                        schemas.BAYESIAN_LINEAR_MODEL,
+                        [glm_to_avro_record("best", best.model, imap)])
+        all_dir = out_dir / "all-models"
+        for t in trained:
+            d = all_dir / str(t.reg_weight)
+            d.mkdir(parents=True, exist_ok=True)
+            write_text_model(d / "model.txt", t.model, imap, t.reg_weight)
+        imap.save(out_dir / "feature-index.json")
+
+    duration = time.perf_counter() - t_start
+    summary = {
+        "jobName": args.job_name,
+        "task": task.value,
+        "stages": stages,
+        "numRows": int(mat.shape[0]),
+        "numFeatures": int(mat.shape[1]),
+        "lambdas": lambdas,
+        "bestLambda": best_lambda,
+        "convergence": {
+            str(t.reg_weight): {
+                "iterations": int(t.result.iterations),
+                "reason": t.result.reason_enum().summary,
+                "finalObjective": float(t.result.value)}
+            for t in trained},
+        "validationMetrics": {str(k): v
+                              for k, v in metrics_by_lambda.items()},
+        "phaseSeconds": timer.phases,
+        "totalSeconds": duration,
+    }
+    (out_dir / "summary.json").write_text(json.dumps(summary, indent=2))
+    emitter.send_event(TrainingFinishEvent(args.job_name, duration))
+    emitter.clear_listeners()
+    logger.info("done in %.1fs; best lambda = %g", duration, best_lambda)
+    return summary
+
+
+def main() -> None:
+    run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

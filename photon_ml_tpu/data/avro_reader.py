@@ -1,0 +1,167 @@
+"""TrainingExampleAvro ingest: Avro files -> IndexMap + CSR + GameDataset.
+
+Replaces the reference's AvroDataReader/GLMSuite Spark ingest
+(ml/data/AvroDataReader.scala:53-436, ml/io/GLMSuite.scala:98-139): reads
+records on the host, indexes (name, term) features, injects the intercept
+column, and produces scipy CSR ready for device upload.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.index_map import (
+    INTERCEPT_KEY,
+    IndexMap,
+    feature_key,
+)
+from photon_ml_tpu.io.avro_codec import read_container
+
+
+def _avro_paths(path) -> List[Path]:
+    p = Path(path)
+    if p.is_dir():
+        files = sorted(q for q in p.iterdir() if q.suffix == ".avro")
+        if not files:
+            raise FileNotFoundError(f"no .avro files under {p}")
+        return files
+    return [p]
+
+
+def iter_records(path) -> Iterator[dict]:
+    for f in _avro_paths(path):
+        yield from read_container(f)
+
+
+def build_index_map(path, add_intercept: bool = True) -> IndexMap:
+    """Scan pass collecting distinct (name, term) keys — the analog of
+    DefaultIndexMap generation / FeatureIndexingJob."""
+    keys = set()
+    for rec in iter_records(path):
+        for f in rec["features"]:
+            keys.add(feature_key(f["name"], f.get("term") or ""))
+    return IndexMap.from_keys(keys, add_intercept=add_intercept)
+
+
+def read_labeled_points(
+    path,
+    index_map: Optional[IndexMap] = None,
+    add_intercept: bool = True,
+    selected_features: Optional[set] = None,
+) -> Tuple[sp.csr_matrix, np.ndarray, np.ndarray, np.ndarray,
+           List[Optional[str]], IndexMap]:
+    """Returns (features, labels, offsets, weights, uids, index_map).
+
+    Unknown features (absent from a supplied index map) are dropped, like
+    the reference's ingest. ``selected_features`` (keys) restricts columns
+    (GLMSuite selected-features filtering).
+    """
+    if index_map is None:
+        index_map = build_index_map(path, add_intercept=add_intercept)
+    intercept_idx = index_map.intercept_index if add_intercept else -1
+
+    labels, offsets, weights, uids = [], [], [], []
+    data, indices, indptr = [], [], [0]
+    for rec in iter_records(path):
+        labels.append(float(rec["label"]))
+        offsets.append(float(rec.get("offset") or 0.0))
+        w = rec.get("weight")
+        weights.append(1.0 if w is None else float(w))
+        uids.append(rec.get("uid"))
+        for f in rec["features"]:
+            key = feature_key(f["name"], f.get("term") or "")
+            if selected_features is not None and key not in selected_features:
+                continue
+            idx = index_map.get_index(key)
+            if idx >= 0:
+                indices.append(idx)
+                data.append(float(f["value"]))
+        if intercept_idx >= 0:
+            indices.append(intercept_idx)
+            data.append(1.0)
+        indptr.append(len(indices))
+
+    n, d = len(labels), len(index_map)
+    mat = sp.csr_matrix(
+        (np.asarray(data), np.asarray(indices, np.int64),
+         np.asarray(indptr, np.int64)), shape=(n, d))
+    mat.sum_duplicates()
+    return (mat, np.asarray(labels), np.asarray(offsets),
+            np.asarray(weights), uids, index_map)
+
+
+def read_game_dataset(
+    path,
+    id_types: Sequence[str],
+    feature_shard_maps: Optional[Dict[str, IndexMap]] = None,
+    add_intercept: bool = True,
+    default_shard: str = "global",
+) -> Tuple[GameDataset, Dict[str, IndexMap]]:
+    """GAME ingest: one feature shard (default: all features) + entity id
+    columns pulled from each record's metadataMap (falling back to uid).
+
+    The reference's richer feature-bag/shard configuration
+    (GameDriver.prepareFeatureMaps) maps onto ``feature_shard_maps``:
+    shard id -> IndexMap restricted to that shard's features.
+    """
+    if feature_shard_maps is None:
+        feature_shard_maps = {
+            default_shard: build_index_map(path, add_intercept=add_intercept)}
+
+    shard_builders = {
+        s: {"data": [], "indices": [], "indptr": [0]}
+        for s in feature_shard_maps}
+    labels, offsets, weights, uids = [], [], [], []
+    ids: Dict[str, list] = {t: [] for t in id_types}
+
+    for rec in iter_records(path):
+        labels.append(float(rec["label"]))
+        offsets.append(float(rec.get("offset") or 0.0))
+        w = rec.get("weight")
+        weights.append(1.0 if w is None else float(w))
+        uids.append(rec.get("uid"))
+        metadata = rec.get("metadataMap") or {}
+        for t in id_types:
+            v = metadata.get(t)
+            if v is None:
+                raise ValueError(
+                    f"record is missing id type {t!r} in metadataMap")
+            ids[t].append(str(v))
+        for shard, imap in feature_shard_maps.items():
+            b = shard_builders[shard]
+            for f in rec["features"]:
+                idx = imap.get_index(feature_key(f["name"],
+                                                 f.get("term") or ""))
+                if idx >= 0:
+                    b["indices"].append(idx)
+                    b["data"].append(float(f["value"]))
+            ii = imap.intercept_index
+            if add_intercept and ii >= 0:
+                b["indices"].append(ii)
+                b["data"].append(1.0)
+            b["indptr"].append(len(b["indices"]))
+
+    n = len(labels)
+    shards = {}
+    for shard, imap in feature_shard_maps.items():
+        b = shard_builders[shard]
+        m = sp.csr_matrix(
+            (np.asarray(b["data"]), np.asarray(b["indices"], np.int64),
+             np.asarray(b["indptr"], np.int64)), shape=(n, len(imap)))
+        m.sum_duplicates()
+        shards[shard] = m
+
+    data = GameDataset.build(
+        responses=np.asarray(labels),
+        feature_shards=shards,
+        ids={t: np.asarray(v) for t, v in ids.items()},
+        offsets=np.asarray(offsets),
+        weights=np.asarray(weights),
+        uids=np.asarray([u if u is not None else "" for u in uids]),
+    )
+    return data, feature_shard_maps

@@ -1,0 +1,19 @@
+"""Estimator APIs: GLM λ-grid training and the GAME estimator."""
+
+from photon_ml_tpu.estimators.model_training import train_glm_models
+from photon_ml_tpu.estimators.model_selection import select_best_model
+from photon_ml_tpu.estimators.game_estimator import (
+    GameEstimator,
+    CoordinateSpec,
+    FixedEffectSpec,
+    RandomEffectSpec,
+)
+
+__all__ = [
+    "train_glm_models",
+    "select_best_model",
+    "GameEstimator",
+    "CoordinateSpec",
+    "FixedEffectSpec",
+    "RandomEffectSpec",
+]

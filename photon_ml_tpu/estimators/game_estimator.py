@@ -1,0 +1,137 @@
+"""GameEstimator: data prep + coordinate construction + grid training.
+
+Reference: ml/estimators/GameEstimator.scala:51-527 — fit() prepares
+per-coordinate datasets once, then trains one CoordinateDescent run per
+combination of per-coordinate optimization configs (the grid at :292-519),
+returning (configs, result) pairs for model selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.algorithm import (
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescentResult
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.evaluation.evaluators import Evaluator
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FixedEffectSpec:
+    name: str
+    feature_shard_id: str
+    configs: Sequence[GLMOptimizationConfiguration]
+    normalization: Optional[object] = None
+
+
+@dataclasses.dataclass
+class RandomEffectSpec:
+    name: str
+    data_config: RandomEffectDataConfiguration
+    configs: Sequence[GLMOptimizationConfiguration]
+    intercept_col: Optional[int] = None
+
+
+CoordinateSpec = Union[FixedEffectSpec, RandomEffectSpec]
+
+
+class GameEstimator:
+    def __init__(
+        self,
+        task_type: TaskType,
+        coordinate_specs: Sequence[CoordinateSpec],  # updating sequence order
+        num_iterations: int = 1,
+        validation_evaluators: Sequence[Evaluator] = (),
+        dtype=jnp.float32,
+        mesh=None,
+    ):
+        if not coordinate_specs:
+            raise ValueError("at least one coordinate spec required")
+        names = [s.name for s in coordinate_specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate coordinate names in {names}")
+        self.task_type = task_type
+        self.specs = list(coordinate_specs)
+        self.num_iterations = num_iterations
+        self.validation_evaluators = list(validation_evaluators)
+        self.dtype = dtype
+        self.mesh = mesh
+
+    def fit(
+        self,
+        data: GameDataset,
+        validation_data: Optional[GameDataset] = None,
+        seed: int = 0,
+    ) -> List[Tuple[Dict[str, GLMOptimizationConfiguration],
+                    CoordinateDescentResult]]:
+        """Train one model per per-coordinate config combination."""
+        re_datasets = {
+            s.name: build_random_effect_dataset(
+                data, s.data_config, seed=seed,
+                intercept_col=s.intercept_col, dtype=self.dtype)
+            for s in self.specs if isinstance(s, RandomEffectSpec)}
+
+        combos = itertools.product(
+            *[[(s.name, c) for c in s.configs] for s in self.specs])
+        results = []
+        for combo in combos:
+            configs = dict(combo)
+            coords = {}
+            for s in self.specs:
+                if isinstance(s, FixedEffectSpec):
+                    coords[s.name] = FixedEffectCoordinate(
+                        name=s.name, data=data,
+                        feature_shard_id=s.feature_shard_id,
+                        task_type=self.task_type, config=configs[s.name],
+                        normalization=s.normalization, dtype=self.dtype,
+                        mesh=self.mesh)
+                else:
+                    coords[s.name] = RandomEffectCoordinate(
+                        name=s.name, dataset=re_datasets[s.name],
+                        task_type=self.task_type, config=configs[s.name],
+                        mesh=self.mesh)
+            cd = CoordinateDescent(
+                coords, self.task_type,
+                validation_data=validation_data,
+                validation_evaluators=self.validation_evaluators)
+            logger.info("training combo %s",
+                        {k: v.to_string() for k, v in configs.items()})
+            results.append((configs, cd.run(self.num_iterations, seed=seed)))
+        return results
+
+    def select_best(
+        self,
+        results,
+    ) -> Tuple[Dict[str, GLMOptimizationConfiguration],
+               CoordinateDescentResult]:
+        """Best combo by the first validation evaluator (falling back to the
+        training objective when no validation ran) — reference:
+        cli/game/training/Driver.selectBestModel (:168-198)."""
+        if not results:
+            raise ValueError("no results")
+        if self.validation_evaluators and results[0][1].validation_history:
+            head = self.validation_evaluators[0]
+            best = None
+            for item in results:
+                metric = item[1].validation_history[-1][head.name]
+                if best is None or head.better_than(metric, best[0]):
+                    best = (metric, item)
+            return best[1]
+        return min(results, key=lambda item: item[1].objective_history[-1])

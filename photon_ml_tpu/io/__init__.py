@@ -1,0 +1,1 @@
+"""IO: Avro container codec, schemas, model persistence."""

@@ -1,0 +1,283 @@
+"""Model persistence, reproducing the reference's on-disk layout.
+
+Reference: ml/avro/model/ModelProcessingUtils.scala:67-545 —
+
+  <root>/model-metadata.json
+  <root>/fixed-effect/<coordinate>/coefficients/part-00000.avro
+  <root>/random-effect/<coordinate>/coefficients/part-00000.avro
+  <root>/random-effect/<coordinate>/id-info
+
+(BayesianLinearModelAvro records; random-effect modelId = entity id.)
+Plus the GLM driver's text model format (ml/util/IOUtils.scala:236-238):
+one line per feature: "name\\tterm\\tcoefficient\\tregWeight".
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.index_map import IndexMap, split_key
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import read_container, write_container
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.fixed_effect import FixedEffectModel
+from photon_ml_tpu.models.game_model import GameModel
+from photon_ml_tpu.models.glm import (
+    GeneralizedLinearModel,
+    model_class_by_name,
+    model_for_task,
+)
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.types import TaskType
+
+
+# ---------------------------------------------------------------------------
+# name/term <-> index helpers
+# ---------------------------------------------------------------------------
+
+
+def _coeff_records(means: np.ndarray, index_map: IndexMap,
+                   variances: Optional[np.ndarray] = None):
+    mean_list, var_list = [], []
+    for key, idx in index_map.key_items():
+        v = float(means[idx])
+        if v == 0.0:
+            continue
+        name, term = split_key(key)
+        mean_list.append({"name": name, "term": term or None, "value": v})
+        if variances is not None:
+            var_list.append({"name": name, "term": term or None,
+                             "value": float(variances[idx])})
+    return mean_list, (var_list if variances is not None else None)
+
+
+def _vector_from_records(records, index_map: IndexMap, d: int) -> np.ndarray:
+    from photon_ml_tpu.data.index_map import feature_key
+
+    out = np.zeros(d)
+    for r in records:
+        idx = index_map.get_index(feature_key(r["name"], r["term"] or ""))
+        if idx >= 0:
+            out[idx] = r["value"]
+    return out
+
+
+def glm_to_avro_record(model_id: str, glm: GeneralizedLinearModel,
+                       index_map: IndexMap) -> dict:
+    means, variances = glm.coefficients.to_numpy()
+    mean_recs, var_recs = _coeff_records(means, index_map, variances)
+    return {
+        "modelId": model_id,
+        "modelClass": glm.model_class_name,
+        "lossFunction": glm.loss.name,
+        "means": mean_recs,
+        "variances": var_recs,
+    }
+
+
+def glm_from_avro_record(rec: dict, index_map: IndexMap
+                         ) -> Tuple[str, GeneralizedLinearModel]:
+    d = len(index_map)
+    means = _vector_from_records(rec["means"], index_map, d)
+    variances = (None if rec.get("variances") is None else
+                 _vector_from_records(rec["variances"], index_map, d))
+    cls = model_class_by_name(rec["modelClass"]) if rec.get("modelClass") \
+        else None
+    if cls is None:
+        raise ValueError(f"model record {rec['modelId']} has no modelClass")
+    coeff = Coefficients(
+        jnp.asarray(means),
+        None if variances is None else jnp.asarray(variances))
+    return rec["modelId"], cls(coeff)
+
+
+# ---------------------------------------------------------------------------
+# GLM driver text models (ml/util/IOUtils.scala:236-238)
+# ---------------------------------------------------------------------------
+
+
+def write_text_model(path, glm: GeneralizedLinearModel, index_map: IndexMap,
+                     reg_weight: float) -> None:
+    means, _ = glm.coefficients.to_numpy()
+    lines = []
+    for key, idx in index_map.key_items():
+        name, term = split_key(key)
+        lines.append(f"{name}\t{term}\t{means[idx]}\t{reg_weight}")
+    Path(path).write_text("\n".join(lines) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# Loaded random-effect models (scoring form)
+# ---------------------------------------------------------------------------
+
+
+class RandomEffectModelSnapshot:
+    """A random-effect model loaded from disk: per-entity global-space
+    coefficient rows. Supports scoring any GameDataset; conversion into the
+    block form for warm-start training happens when a dataset is available
+    (RandomEffectModel.zeros_like_dataset + gather)."""
+
+    def __init__(self, random_effect_type: str, feature_shard_id: str,
+                 matrix: sp.csr_matrix, vocabulary: np.ndarray):
+        self.random_effect_type = random_effect_type
+        self.feature_shard_id = feature_shard_id
+        self.matrix = matrix.tocsr()
+        self.vocabulary = np.asarray(vocabulary)
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.vocabulary)
+
+    def score_numpy(self, data) -> np.ndarray:
+        mat = data.feature_shards[self.feature_shard_id].tocsr()
+        col = data.id_columns[self.random_effect_type]
+        idx = {str(n): i for i, n in enumerate(self.vocabulary)}
+        mapped = np.asarray(
+            [idx.get(str(n), -1) for n in col.vocabulary], np.int64)[col.codes]
+        valid = mapped >= 0
+        scores = np.zeros(data.num_rows)
+        if valid.any():
+            rows = np.flatnonzero(valid)
+            scores[rows] = np.asarray(
+                mat[rows].multiply(self.matrix[mapped[valid]]).sum(axis=1)
+            ).ravel()
+        return scores
+
+
+# ---------------------------------------------------------------------------
+# GAME model save / load
+# ---------------------------------------------------------------------------
+
+FIXED_DIR = "fixed-effect"
+RANDOM_DIR = "random-effect"
+METADATA_FILE = "model-metadata.json"
+ID_INFO_FILE = "id-info"
+COEFF_DIR = "coefficients"
+PART_FILE = "part-00000.avro"
+
+
+def save_game_model(
+    root, game_model: GameModel, index_maps: Dict[str, IndexMap],
+    metadata_extras: Optional[dict] = None,
+) -> None:
+    """index_maps: feature_shard_id -> IndexMap (reference: one feature
+    index per shard)."""
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    meta = {
+        "taskType": game_model.task_type.value,
+        "coordinates": [],
+        **(metadata_extras or {}),
+    }
+    for name, model in game_model.models.items():
+        if isinstance(model, FixedEffectModel):
+            d = root / FIXED_DIR / name / COEFF_DIR
+            d.mkdir(parents=True, exist_ok=True)
+            imap = index_maps[model.feature_shard_id]
+            write_container(
+                d / PART_FILE, schemas.BAYESIAN_LINEAR_MODEL,
+                [glm_to_avro_record("fixed effect", model.glm, imap)])
+            meta["coordinates"].append({
+                "name": name, "kind": "fixed",
+                "featureShardId": model.feature_shard_id})
+        elif isinstance(model, (RandomEffectModel, RandomEffectModelSnapshot)):
+            d = root / RANDOM_DIR / name / COEFF_DIR
+            d.mkdir(parents=True, exist_ok=True)
+            imap = index_maps[model.feature_shard_id]
+            glm_cls = model_for_task(game_model.task_type)
+            if isinstance(model, RandomEffectModel):
+                entity_rows = model.to_entity_dict()
+            else:
+                m = model.matrix
+                entity_rows = {
+                    str(n): (m.indices[m.indptr[i]:m.indptr[i + 1]],
+                             m.data[m.indptr[i]:m.indptr[i + 1]])
+                    for i, n in enumerate(model.vocabulary)}
+            dim = len(imap)
+            records = []
+            for entity, (cols, vals) in sorted(entity_rows.items()):
+                means = np.zeros(dim)
+                means[cols] = vals
+                records.append(glm_to_avro_record(
+                    entity, glm_cls(Coefficients(jnp.asarray(means))), imap))
+            write_container(d / PART_FILE, schemas.BAYESIAN_LINEAR_MODEL,
+                            records)
+            (root / RANDOM_DIR / name / ID_INFO_FILE).write_text(
+                json.dumps({"randomEffectType": model.random_effect_type,
+                            "featureShardId": model.feature_shard_id}))
+            meta["coordinates"].append({
+                "name": name, "kind": "random",
+                "randomEffectType": model.random_effect_type,
+                "featureShardId": model.feature_shard_id})
+        elif isinstance(model, MatrixFactorizationModel):
+            d = root / "matrix-factorization" / name
+            d.mkdir(parents=True, exist_ok=True)
+            for which, factors, vocab in (
+                    ("row", model.row_factors, model.row_vocabulary),
+                    ("col", model.col_factors, model.col_vocabulary)):
+                write_container(
+                    d / f"{which}-latent-factors.avro", schemas.LATENT_FACTOR,
+                    [{"effectId": str(n),
+                      "latentFactor": [float(v) for v in np.asarray(f)]}
+                     for n, f in zip(vocab, np.asarray(factors))])
+            (d / ID_INFO_FILE).write_text(json.dumps({
+                "rowEffectType": model.row_effect_type,
+                "colEffectType": model.col_effect_type}))
+            meta["coordinates"].append({"name": name, "kind": "mf"})
+        else:
+            raise TypeError(f"cannot save model type {type(model)}")
+    (root / METADATA_FILE).write_text(json.dumps(meta, indent=2))
+
+
+def load_game_model(root, index_maps: Dict[str, IndexMap]) -> GameModel:
+    root = Path(root)
+    meta = json.loads((root / METADATA_FILE).read_text())
+    task = TaskType(meta["taskType"])
+    models: Dict[str, object] = {}
+    for coord in meta["coordinates"]:
+        name = coord["name"]
+        if coord["kind"] == "fixed":
+            shard = coord["featureShardId"]
+            recs = list(read_container(
+                root / FIXED_DIR / name / COEFF_DIR / PART_FILE))
+            _, glm = glm_from_avro_record(recs[0], index_maps[shard])
+            models[name] = FixedEffectModel(glm, shard)
+        elif coord["kind"] == "random":
+            info = json.loads(
+                (root / RANDOM_DIR / name / ID_INFO_FILE).read_text())
+            shard = info["featureShardId"]
+            imap = index_maps[shard]
+            d = len(imap)
+            entities, rows_list = [], []
+            for rec in read_container(
+                    root / RANDOM_DIR / name / COEFF_DIR / PART_FILE):
+                entity, glm = glm_from_avro_record(rec, imap)
+                entities.append(entity)
+                rows_list.append(np.asarray(glm.coefficients.means))
+            matrix = sp.csr_matrix(np.vstack(rows_list)) if rows_list else \
+                sp.csr_matrix((0, d))
+            models[name] = RandomEffectModelSnapshot(
+                info["randomEffectType"], shard, matrix,
+                np.asarray(entities))
+        elif coord["kind"] == "mf":
+            d = root / "matrix-factorization" / name
+            info = json.loads((d / ID_INFO_FILE).read_text())
+            vocabs, factors = [], []
+            for which in ("row", "col"):
+                recs = list(read_container(d / f"{which}-latent-factors.avro"))
+                vocabs.append(np.asarray([r["effectId"] for r in recs]))
+                factors.append(jnp.asarray(
+                    np.asarray([r["latentFactor"] for r in recs])))
+            models[name] = MatrixFactorizationModel(
+                info["rowEffectType"], info["colEffectType"],
+                factors[0], factors[1], vocabs[0], vocabs[1])
+        else:
+            raise ValueError(f"unknown coordinate kind {coord['kind']!r}")
+    return GameModel(models, task)

@@ -154,6 +154,13 @@ class GLMOptimizationConfiguration:
         default_factory=RegularizationContext)
 
     def __post_init__(self):
+        if (self.regularization_weight > 0 and
+                self.regularization_context.reg_type ==
+                RegularizationType.NONE):
+            raise ValueError(
+                f"regularization weight {self.regularization_weight} has no "
+                "effect with regularization type NONE — pass a "
+                "RegularizationContext(L1|L2|ELASTIC_NET) or weight 0")
         if not (0.0 < self.down_sampling_rate <= 1.0):
             raise ValueError(
                 f"downSamplingRate must be in (0, 1], got "

@@ -73,16 +73,21 @@ def solve_glm(
         upper_bounds=upper_bounds)
 
 
-def regularization_term(config: GLMOptimizationConfiguration, coefs) -> float:
+def regularization_term(config: GLMOptimizationConfiguration, coefs):
     """lambda-weighted penalty of a coefficient array (for the coordinate-
-    descent objective, CoordinateDescent.scala:203-212)."""
+    descent objective, CoordinateDescent.scala:203-212).
+
+    Returns a DEVICE scalar (python 0.0 when unregularized) — callers sum
+    terms and convert to float once, so remote-TPU dispatch latency is paid
+    once per objective evaluation, not once per term.
+    """
     lam = config.regularization_weight
     rc = config.regularization_context
     l1 = rc.l1_weight(lam)
     l2 = rc.l2_weight(lam)
     out = 0.0
     if l2 > 0:
-        out = out + 0.5 * l2 * float(jnp.sum(jnp.square(coefs)))
+        out = out + 0.5 * l2 * jnp.sum(jnp.square(coefs))
     if l1 > 0:
-        out = out + l1 * float(jnp.sum(jnp.abs(coefs)))
+        out = out + l1 * jnp.sum(jnp.abs(coefs))
     return out

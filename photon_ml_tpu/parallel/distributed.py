@@ -24,7 +24,7 @@ partitioner inserts psum/all-gather where the math requires, which is the
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,23 @@
+"""Cross-cutting utilities: logging, timing, events."""
+
+from photon_ml_tpu.utils.timer import Timer
+from photon_ml_tpu.utils.logging_utils import setup_photon_logger
+from photon_ml_tpu.utils.events import (
+    Event,
+    EventEmitter,
+    EventListener,
+    PhotonOptimizationLogEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+
+__all__ = [
+    "Timer",
+    "setup_photon_logger",
+    "Event",
+    "EventEmitter",
+    "EventListener",
+    "PhotonOptimizationLogEvent",
+    "TrainingStartEvent",
+    "TrainingFinishEvent",
+]

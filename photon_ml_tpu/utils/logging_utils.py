@@ -1,0 +1,32 @@
+"""Job logging to a file + console (reference: ml/util/PhotonLogger.scala:36-506,
+which writes leveled logs to an HDFS file per job)."""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Optional
+
+LOG_FILE_NAME = "log-message.txt"
+
+
+def setup_photon_logger(output_dir: Optional[str] = None,
+                        level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger("photon_ml_tpu")
+    logger.setLevel(level)
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    if not any(isinstance(h, logging.StreamHandler)
+               for h in logger.handlers):
+        sh = logging.StreamHandler()
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+    if output_dir is not None:
+        path = Path(output_dir) / LOG_FILE_NAME
+        path.parent.mkdir(parents=True, exist_ok=True)
+        if not any(isinstance(h, logging.FileHandler) and
+                   h.baseFilename == str(path) for h in logger.handlers):
+            fh = logging.FileHandler(path)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+    return logger

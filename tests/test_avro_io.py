@@ -1,0 +1,180 @@
+"""Avro codec + data reader + model persistence round trips
+(reference: AvroUtilsTest, ModelProcessingUtilsTest patterns)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.avro_reader import (
+    build_index_map,
+    read_game_dataset,
+    read_labeled_points,
+)
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import (
+    read_container,
+    write_container,
+    container_schema,
+)
+from photon_ml_tpu.io.model_io import (
+    RandomEffectModelSnapshot,
+    glm_from_avro_record,
+    glm_to_avro_record,
+    load_game_model,
+    save_game_model,
+    write_text_model,
+)
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    LogisticRegressionModel,
+    MatrixFactorizationModel,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def _examples():
+    return [
+        {"uid": "r1", "label": 1.0,
+         "features": [{"name": "f1", "term": None, "value": 0.5},
+                      {"name": "f2", "term": "t", "value": -1.0}],
+         "weight": 2.0, "offset": 0.1,
+         "metadataMap": {"userId": "alice", "itemId": "x"}},
+        {"uid": "r2", "label": 0.0,
+         "features": [{"name": "f1", "term": None, "value": 1.5}],
+         "weight": None, "offset": None,
+         "metadataMap": {"userId": "bob", "itemId": "x"}},
+    ]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_container_round_trip(tmp_path, codec):
+    p = tmp_path / "data.avro"
+    write_container(p, schemas.TRAINING_EXAMPLE, _examples(), codec=codec)
+    back = list(read_container(p))
+    assert back == [
+        {**e, "weight": e["weight"], "offset": e["offset"]}
+        for e in _examples()]
+    assert container_schema(p)["name"] == "TrainingExampleAvro"
+
+
+def test_container_multi_block(tmp_path):
+    p = tmp_path / "big.avro"
+    recs = [{"uid": None, "label": float(i),
+             "features": [{"name": f"f{i % 50}", "term": None,
+                           "value": i * 0.5}],
+             "weight": None, "offset": None, "metadataMap": None}
+            for i in range(5000)]
+    write_container(p, schemas.TRAINING_EXAMPLE, recs, sync_interval=1024)
+    back = list(read_container(p))
+    assert len(back) == 5000
+    assert back[4321]["label"] == 4321.0
+
+
+def test_read_labeled_points(tmp_path):
+    p = tmp_path / "train.avro"
+    write_container(p, schemas.TRAINING_EXAMPLE, _examples())
+    mat, y, off, w, uids, imap = read_labeled_points(p)
+    assert mat.shape == (2, 3)  # f1, f2:t, intercept
+    assert len(imap) == 3
+    np.testing.assert_allclose(y, [1.0, 0.0])
+    np.testing.assert_allclose(off, [0.1, 0.0])
+    np.testing.assert_allclose(w, [2.0, 1.0])
+    assert uids == ["r1", "r2"]
+    i1 = imap.get_index(feature_key("f1"))
+    np.testing.assert_allclose(mat.toarray()[:, i1], [0.5, 1.5])
+    np.testing.assert_allclose(mat.toarray()[:, imap.intercept_index], 1.0)
+
+
+def test_read_game_dataset(tmp_path):
+    p = tmp_path / "game.avro"
+    write_container(p, schemas.TRAINING_EXAMPLE, _examples())
+    data, shard_maps = read_game_dataset(p, id_types=["userId", "itemId"])
+    assert data.num_rows == 2
+    assert set(shard_maps) == {"global"}
+    assert data.id_columns["userId"].vocabulary.tolist() == ["alice", "bob"]
+    with pytest.raises(ValueError, match="missing id type"):
+        read_game_dataset(p, id_types=["queryId"])
+
+
+def test_glm_avro_record_round_trip():
+    imap = IndexMap.from_name_terms([("a", ""), ("b", "t")],
+                                    add_intercept=True)
+    means = jnp.asarray([1.5, 0.0, -0.25])
+    variances = jnp.asarray([0.1, 0.2, 0.3])
+    glm = LogisticRegressionModel(Coefficients(means, variances))
+    rec = glm_to_avro_record("m1", glm, imap)
+    assert rec["modelClass"] == "LogisticRegressionModel"
+    # zero coefficient omitted
+    assert len(rec["means"]) == 2
+    mid, back = glm_from_avro_record(rec, imap)
+    assert mid == "m1"
+    np.testing.assert_allclose(np.asarray(back.coefficients.means),
+                               [1.5, 0.0, -0.25])
+    assert isinstance(back, LogisticRegressionModel)
+
+
+def test_text_model_format(tmp_path):
+    imap = IndexMap.from_name_terms([("age", ""), ("f", "x")],
+                                    add_intercept=True)
+    glm = LogisticRegressionModel(
+        Coefficients(jnp.asarray([1.0, 2.0, -0.5])))
+    out = tmp_path / "model.txt"
+    write_text_model(out, glm, imap, reg_weight=10.0)
+    lines = out.read_text().strip().split("\n")
+    assert len(lines) == 3
+    cols = lines[0].split("\t")
+    assert len(cols) == 4 and cols[3] == "10.0"
+
+
+def test_game_model_save_load_round_trip(tmp_path, rng):
+    imap_g = IndexMap.from_name_terms([("x1", ""), ("x2", "")],
+                                      add_intercept=True)
+    imap_u = IndexMap.from_name_terms([], add_intercept=True)
+    fe = FixedEffectModel(
+        LogisticRegressionModel(
+            Coefficients(jnp.asarray([0.5, -1.0, 0.25]))), "global")
+    re = RandomEffectModelSnapshot(
+        "userId", "user",
+        sp.csr_matrix(np.asarray([[0.7], [-0.3]])),
+        np.asarray(["alice", "bob"]))
+    mf = MatrixFactorizationModel(
+        "userId", "itemId",
+        jnp.asarray(rng.normal(0, 1, (2, 3))),
+        jnp.asarray(rng.normal(0, 1, (2, 3))),
+        np.asarray(["alice", "bob"]), np.asarray(["x", "y"]))
+    gm = GameModel({"fixed": fe, "perUser": re, "mf": mf},
+                   TaskType.LOGISTIC_REGRESSION)
+    root = tmp_path / "model"
+    save_game_model(root, gm, {"global": imap_g, "user": imap_u})
+    assert (root / "fixed-effect" / "fixed" / "coefficients" /
+            "part-00000.avro").exists()
+    assert (root / "random-effect" / "perUser" / "id-info").exists()
+
+    back = load_game_model(root, {"global": imap_g, "user": imap_u})
+    assert back.task_type == TaskType.LOGISTIC_REGRESSION
+    np.testing.assert_allclose(
+        np.asarray(back.get_model("fixed").glm.coefficients.means),
+        [0.5, -1.0, 0.25])
+    re2 = back.get_model("perUser")
+    assert re2.vocabulary.tolist() == ["alice", "bob"]
+    np.testing.assert_allclose(re2.matrix.toarray(), [[0.7], [-0.3]])
+    mf2 = back.get_model("mf")
+    np.testing.assert_allclose(np.asarray(mf2.row_factors),
+                               np.asarray(mf.row_factors), rtol=1e-12)
+
+    # Scores agree before/after the round trip on a real dataset.
+    n = 4
+    data_mat = sp.csr_matrix(
+        np.hstack([rng.normal(0, 1, (n, 2)), np.ones((n, 1))]))
+    user_mat = sp.csr_matrix(np.ones((n, 1)))
+    from photon_ml_tpu.data.game_data import GameDataset
+    data = GameDataset.build(
+        responses=np.zeros(n),
+        feature_shards={"global": data_mat, "user": user_mat},
+        ids={"userId": np.asarray(["alice", "bob", "carol", "alice"]),
+             "itemId": np.asarray(["x", "y", "x", "z"])})
+    np.testing.assert_allclose(back.score(data), gm.score(data), rtol=1e-6)

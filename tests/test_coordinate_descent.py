@@ -18,7 +18,11 @@ from photon_ml_tpu.data.random_effect import (
     build_random_effect_dataset,
 )
 from photon_ml_tpu.evaluation import build_evaluator
-from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+    RegularizationType,
+)
 from photon_ml_tpu.types import TaskType
 
 
@@ -44,9 +48,11 @@ def make_glmix_data(rng, n=400, d=6, n_users=12, user_strength=2.0):
 def build_coordinates(data, fe_cfg=None, re_cfg=None):
     fe_cfg = fe_cfg or GLMOptimizationConfiguration(
         max_iterations=50, tolerance=1e-8, regularization_weight=0.1,
+        regularization_context=RegularizationContext(RegularizationType.L2),
     )
     re_cfg = re_cfg or GLMOptimizationConfiguration(
         max_iterations=30, tolerance=1e-8, regularization_weight=0.1,
+        regularization_context=RegularizationContext(RegularizationType.L2),
     )
     re_data = build_random_effect_dataset(
         data, RandomEffectDataConfiguration("userId", "user"),
@@ -70,7 +76,8 @@ def test_fixed_effect_only_descent(rng):
     w = np.asarray(fe.glm.coefficients.means)
     corr = np.corrcoef(w, w_global)[0, 1]
     assert corr > 0.9
-    assert res.objective_history[-1] <= res.objective_history[0] + 1e-6
+    h = res.objective_history
+    assert h[-1] <= h[0] + 1e-5 * abs(h[0])  # f32 noise margin
 
 
 def test_glmix_descent_improves_and_recovers_user_bias(rng):
