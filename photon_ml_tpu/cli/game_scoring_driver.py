@@ -54,6 +54,9 @@ def run(argv=None) -> dict:
     id_types = sorted(
         {c["randomEffectType"] for c in meta["coordinates"]
          if c["kind"] == "random"} |
+        # MF coordinates key rows by both their entity axes.
+        {c[k] for c in meta["coordinates"] if c["kind"] == "mf"
+         for k in ("rowEffectType", "colEffectType")} |
         {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
 
     data, _ = read_game_dataset(args.input_dirs, id_types=id_types,

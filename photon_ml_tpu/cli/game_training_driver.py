@@ -118,6 +118,13 @@ def run(argv=None) -> dict:
         return [GLMOptimizationConfiguration.parse(part)
                 for part in s.split("|")]
 
+    def opt_grid(table, name, flag):
+        if name not in table:
+            raise ValueError(
+                f"coordinate {name!r} has no optimization configuration — "
+                f"pass it via {flag} (have {sorted(table) or 'none'})")
+        return parse_grid(table[name])
+
     specs = []
     for name in sequence:
         if name in fe_data:
@@ -128,7 +135,9 @@ def run(argv=None) -> dict:
                     f"feature shard {shard!r} (have {sorted(shard_maps)})")
             specs.append(FixedEffectSpec(
                 name=name, feature_shard_id=shard,
-                configs=parse_grid(fe_opt[name])))
+                configs=opt_grid(
+                    fe_opt, name,
+                    "--fixed-effect-optimization-configurations")))
         else:
             cfg = re_data[name]
             if cfg.feature_shard_id not in shard_maps:
@@ -137,7 +146,10 @@ def run(argv=None) -> dict:
                     f"feature shard {cfg.feature_shard_id!r}")
             imap = shard_maps[cfg.feature_shard_id]
             specs.append(RandomEffectSpec(
-                name=name, data_config=cfg, configs=parse_grid(re_opt[name]),
+                name=name, data_config=cfg,
+                configs=opt_grid(
+                    re_opt, name,
+                    "--random-effect-optimization-configurations"),
                 intercept_col=(imap.intercept_index
                                if imap.intercept_index >= 0 else None)))
 

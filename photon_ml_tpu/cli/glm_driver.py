@@ -91,9 +91,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _load(path: str, fmt: str, add_intercept: bool, task: TaskType,
-          index_map: IndexMap | None = None):
-    """index_map: pass the training map when loading validation data so
-    columns decode identically (the reference shares one feature index)."""
+          index_map: IndexMap | None = None,
+          num_raw_features: int | None = None):
+    """index_map / num_raw_features: pass the training map (AVRO) or the
+    training feature width before intercept (LIBSVM) when loading validation
+    data, so columns decode identically (the reference shares one feature
+    index across splits)."""
     if fmt == "AVRO":
         mat, y, off, w, _, imap = read_labeled_points(
             path, index_map=index_map, add_intercept=add_intercept)
@@ -111,6 +114,11 @@ def _load(path: str, fmt: str, add_intercept: bool, task: TaskType,
     import scipy.sparse as sp
 
     d = max(m.shape[1] for m in mats)
+    if num_raw_features is not None:
+        # Validation width is dictated by training: features unseen at
+        # training time are dropped (the shared index has no slot for them).
+        d = num_raw_features
+        mats = [m[:, :d] if m.shape[1] > d else m for m in mats]
     mats = [sp.csr_matrix((m.data, m.indices, m.indptr), shape=(m.shape[0], d))
             for m in mats]
     mat = sp.vstack(mats, format="csr")
@@ -138,8 +146,13 @@ def run(argv=None) -> dict:
     emitter.send_event(TrainingStartEvent(args.job_name))
     t_start = time.perf_counter()
 
+    import jax
     import jax.numpy as jnp
 
+    if args.dtype == "float64":
+        # Without this, jnp.asarray(..., float64) silently yields float32
+        # and the whole solve runs at the wrong precision.
+        jax.config.update("jax_enable_x64", True)
     dtype = jnp.float64 if args.dtype == "float64" else jnp.float32
 
     # ---- preprocess ------------------------------------------------------
@@ -194,7 +207,9 @@ def run(argv=None) -> dict:
         with timer.time("validate"):
             vmat, vy, voff, vw, _ = _load(
                 args.validating_data_directory, args.format, add_intercept,
-                task, index_map=imap if args.format == "AVRO" else None)
+                task, index_map=imap if args.format == "AVRO" else None,
+                num_raw_features=(mat.shape[1] - int(add_intercept)
+                                  if args.format == "LIBSVM" else None))
             if vmat.shape[1] != mat.shape[1]:
                 raise ValueError(
                     f"validation feature dim {vmat.shape[1]} != "

@@ -21,12 +21,11 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
-from photon_ml_tpu.ops.features import DenseFeatures, csr_from_scipy
+from photon_ml_tpu.ops.features import (
+    DENSE_DENSITY_THRESHOLD,
+    features_to_device,
+)
 from photon_ml_tpu.ops.glm_objective import GLMBatch
-
-# Feature matrices denser than this are shipped to the device as plain dense
-# arrays (MXU-friendly); sparser ones go as expanded-CSR segment-sum layout.
-DENSE_DENSITY_THRESHOLD = 0.2
 
 
 @dataclasses.dataclass
@@ -120,11 +119,7 @@ class GameDataset:
         """Materialize one feature shard as a device GLMBatch
         (the analog of FixedEffectDataSet, ml/data/FixedEffectDataSet.scala:29-103)."""
         mat = self.feature_shards[shard_id]
-        density = mat.nnz / max(1, mat.shape[0] * mat.shape[1])
-        if density >= dense_threshold:
-            feats = DenseFeatures(jnp.asarray(mat.toarray(), dtype))
-        else:
-            feats = csr_from_scipy(mat, dtype=dtype)
+        feats = features_to_device(mat, dtype, dense_threshold)
         off = self.offsets if extra_offsets is None else \
             self.offsets + extra_offsets
         return GLMBatch(

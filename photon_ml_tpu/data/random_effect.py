@@ -123,7 +123,13 @@ class EntityBlock:
 
 @dataclasses.dataclass
 class RandomEffectDataset:
-    """All buckets for one random-effect coordinate."""
+    """All buckets for one random-effect coordinate.
+
+    ``projection`` is set when the blocks live in a Gaussian-projected latent
+    space (reference: RandomEffectDataSetInProjectedSpace.scala with
+    ProjectionMatrixBroadcast); None means local spaces are column gathers of
+    the global space (index-map / identity projector).
+    """
 
     config: RandomEffectDataConfiguration
     blocks: List[EntityBlock]  # active data
@@ -132,6 +138,7 @@ class RandomEffectDataset:
     vocabulary: np.ndarray  # entity name per code
     n_rows: int  # global row count == scatter sentinel
     num_global_features: int
+    projection: Optional[object] = None  # projector.ProjectionMatrix
 
     @property
     def num_entities(self) -> int:
@@ -204,6 +211,7 @@ class _EntityRows:
     passive: np.ndarray
     weight_multiplier: float
     local_cols: np.ndarray  # selected global feature columns
+    d_local: int = 0  # local block width (== len(local_cols) unless projected)
 
 
 def build_random_effect_dataset(
@@ -216,17 +224,25 @@ def build_random_effect_dataset(
     min_cols_pad: int = 8,
 ) -> RandomEffectDataset:
     """Group → cap → select → bucket. Host-side, runs once at ingest
-    (replacing the reference's per-iteration Spark shuffles)."""
-    if config.projector_type.startswith("RANDOM"):
-        raise NotImplementedError(
-            "RANDOM projection for random-effect datasets is not implemented "
-            "yet; use INDEX_MAP or IDENTITY")
+    (replacing the reference's per-iteration Spark shuffles).
+
+    With ``projector_type=RANDOM=<k>`` the packed blocks live in the shared
+    Gaussian latent space (reference: RandomEffectProjector.scala:54-66 +
+    ProjectionMatrixBroadcast): Pearson selection still applies first (on
+    global columns, mirroring RandomEffectDataSet.scala:380-394 running
+    before projection), then each entity's rows are projected through the
+    one replicated matrix.
+    """
+    from photon_ml_tpu.projector import build_random_effect_projector
+
     identity = config.projector_type == "IDENTITY"
 
     col = data.id_columns[config.random_effect_type]
     mat = data.feature_shards[config.feature_shard_id].tocsr()
     n_rows, d_global = mat.shape
     rng = np.random.default_rng(seed)
+    projection = build_random_effect_projector(
+        config.projector_type, d_global, intercept_col, seed=seed)
 
     from photon_ml_tpu.data.game_data import group_rows_by_code
     groups = group_rows_by_code(col.codes)
@@ -267,13 +283,16 @@ def build_random_effect_dataset(
                 top = np.argsort(-scores, kind="stable")[:keep]
                 observed = observed[np.sort(top)]
         observed = np.sort(observed)
-        entities.append(_EntityRows(code, active, passive, mult, observed))
+        d_local = (projection.projected_space_dimension
+                   if projection is not None else len(observed))
+        entities.append(
+            _EntityRows(code, active, passive, mult, observed, d_local))
 
     # Bucket by padded size classes.
     buckets: Dict[Tuple[int, int, int], List[_EntityRows]] = {}
     for e in entities:
         n_pad = _next_size(len(e.active), min_rows_pad)
-        d_pad = _next_size(max(len(e.local_cols), 1), min_cols_pad)
+        d_pad = _next_size(max(e.d_local, 1), min_cols_pad)
         p_pad = _next_size(len(e.passive), 1) if len(e.passive) else 0
         buckets.setdefault((n_pad, d_pad, p_pad), []).append(e)
 
@@ -281,11 +300,11 @@ def build_random_effect_dataset(
     for (n_pad, d_pad, p_pad), members in sorted(buckets.items()):
         blocks.append(_pack_block(
             members, [m.active for m in members], n_pad, d_pad, data, mat,
-            n_rows, dtype, weight_mult=True))
+            n_rows, dtype, weight_mult=True, projection=projection))
         if p_pad:
             passive_blocks.append(_pack_block(
                 members, [m.passive for m in members], p_pad, d_pad, data,
-                mat, n_rows, dtype, weight_mult=False))
+                mat, n_rows, dtype, weight_mult=False, projection=projection))
         else:
             passive_blocks.append(None)
         codes_per_block.append(np.asarray([m.code for m in members],
@@ -294,14 +313,14 @@ def build_random_effect_dataset(
     return RandomEffectDataset(
         config=config, blocks=blocks, passive_blocks=passive_blocks,
         entity_codes=codes_per_block, vocabulary=col.vocabulary,
-        n_rows=n_rows, num_global_features=d_global,
+        n_rows=n_rows, num_global_features=d_global, projection=projection,
     )
 
 
 def _pack_block(
     members: List[_EntityRows], row_sets: List[np.ndarray], n_pad: int,
     d_pad: int, data: GameDataset, mat: sp.csr_matrix, n_rows: int, dtype,
-    weight_mult: bool,
+    weight_mult: bool, projection=None,
 ) -> EntityBlock:
     E = len(members)
     x = np.zeros((E, n_pad, d_pad), np.float32)
@@ -316,14 +335,24 @@ def _pack_block(
         if k == 0:
             continue
         cols = m.local_cols
-        sub = mat[rows][:, cols].toarray()
-        x[i, :k, :len(cols)] = sub
+        if projection is not None:
+            # Latent-space block: restrict to the Pearson-kept columns on
+            # both sides (equivalent to zeroing dropped columns, then
+            # projecting the full global vector through P).
+            k1 = projection.projected_space_dimension
+            sub = np.asarray(
+                mat[rows][:, cols] @ projection.matrix[:, cols].T)
+            x[i, :k, :k1] = sub
+            feat_idx[i, :k1] = np.arange(k1)
+        else:
+            sub = mat[rows][:, cols].toarray()
+            x[i, :k, :len(cols)] = sub
+            feat_idx[i, :len(cols)] = cols
         labels[i, :k] = data.responses[rows]
         offsets[i, :k] = data.offsets[rows]
         w = data.weights[rows]
         weights[i, :k] = w * (m.weight_multiplier if weight_mult else 1.0)
         row_ids[i, :k] = rows
-        feat_idx[i, :len(cols)] = cols
 
     as_dev = lambda a: jnp.asarray(a, dtype) if a.dtype == np.float32 \
         else jnp.asarray(a)

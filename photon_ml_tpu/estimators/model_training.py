@@ -16,17 +16,20 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
-from photon_ml_tpu.data.game_data import DENSE_DENSITY_THRESHOLD
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel, model_for_task
-from photon_ml_tpu.ops.features import DenseFeatures, csr_from_scipy
+from photon_ml_tpu.ops.features import (
+    DENSE_DENSITY_THRESHOLD,
+    features_to_device,
+)
 from photon_ml_tpu.ops.glm_objective import GLMObjective, make_batch
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.optimization.config import (
     GLMOptimizationConfiguration,
     OptimizerType,
     RegularizationContext,
+    RegularizationType,
 )
 from photon_ml_tpu.optimization.convergence import OptimizerResult
 from photon_ml_tpu.optimization.solver import solve_glm
@@ -46,14 +49,7 @@ def device_batch(features, labels, offsets=None, weights=None,
                  dtype=jnp.float32,
                  dense_threshold: float = DENSE_DENSITY_THRESHOLD):
     """Host arrays -> device GLMBatch, choosing dense vs CSR layout."""
-    if sp.issparse(features):
-        density = features.nnz / max(1, features.shape[0] * features.shape[1])
-        if density >= dense_threshold:
-            feats = DenseFeatures(jnp.asarray(features.toarray(), dtype))
-        else:
-            feats = csr_from_scipy(features, dtype=dtype)
-    else:
-        feats = DenseFeatures(jnp.asarray(np.asarray(features), dtype))
+    feats = features_to_device(features, dtype, dense_threshold)
     return make_batch(
         feats, jnp.asarray(labels, dtype),
         None if offsets is None else jnp.asarray(offsets, dtype),
@@ -65,7 +61,10 @@ def train_glm_models(
     labels,
     task: TaskType,
     regularization_weights: Sequence[float],
-    regularization_context: RegularizationContext = RegularizationContext(),
+    # L2 by default, matching the reference driver (ml/Params.scala:66-91) —
+    # a NONE default would silently ignore the caller's λ grid.
+    regularization_context: RegularizationContext = RegularizationContext(
+        RegularizationType.L2),
     optimizer_type: OptimizerType = OptimizerType.LBFGS,
     max_iterations: int = 80,
     tolerance: float = 1e-6,

@@ -48,11 +48,13 @@ def _coeff_records(means: np.ndarray, index_map: IndexMap,
     mean_list, var_list = [], []
     for key, idx in index_map.key_items():
         v = float(means[idx])
-        if v == 0.0:
-            continue
         name, term = split_key(key)
-        mean_list.append({"name": name, "term": term or None, "value": v})
-        if variances is not None:
+        if v != 0.0:
+            mean_list.append(
+                {"name": name, "term": term or None, "value": v})
+        # Variances are kept independently of the mean: a coefficient L1-ed
+        # to exactly 0 can still carry a nonzero posterior variance.
+        if variances is not None and float(variances[idx]) != 0.0:
             var_list.append({"name": name, "term": term or None,
                              "value": float(variances[idx])})
     return mean_list, (var_list if variances is not None else None)

@@ -33,6 +33,10 @@ class RandomEffectModel:
     entity_codes: List[np.ndarray]  # [E_b] per bucket
     vocabulary: np.ndarray  # entity name per code
     num_global_features: int
+    # Set when local_coefs live in a Gaussian-projected latent space
+    # (reference: RandomEffectModelInProjectedSpace.scala — conversion back
+    # to the original space is Pᵀ @ γ); feat_idx then holds latent ids.
+    projection: Optional[object] = None  # projector.ProjectionMatrix
 
     @property
     def num_entities(self) -> int:
@@ -49,7 +53,19 @@ class RandomEffectModel:
         Codes never trained (or unseen at training) are zero rows — matching
         the reference's join semantics where missing entities contribute no
         score (RandomEffectModel.scala score join).
+
+        Projected-space models are converted back via Pᵀ @ γ per entity
+        (reference: RandomEffectModelInProjectedSpace ->
+        projectCoefficientsRDD).
         """
+        n_codes = len(self.vocabulary)
+        if self.projection is not None:
+            p = self.projection.matrix  # [k1, d_global]
+            dense = np.zeros((n_codes, self.num_global_features))
+            for coefs, codes in zip(self.local_coefs, self.entity_codes):
+                c = np.asarray(coefs)[:, : p.shape[0]]
+                dense[codes] = c @ p
+            return sp.csr_matrix(dense)
         rows, cols, vals = [], [], []
         for coefs, fidx, codes in zip(self.local_coefs, self.feat_idx,
                                       self.entity_codes):
@@ -61,7 +77,6 @@ class RandomEffectModel:
                 rows.extend([code] * int(nz.sum()))
                 cols.extend(f[i][nz].tolist())
                 vals.extend(c[i][nz].tolist())
-        n_codes = len(self.vocabulary)
         return sp.csr_matrix(
             (vals, (rows, cols)), shape=(n_codes, self.num_global_features))
 
@@ -115,4 +130,5 @@ class RandomEffectModel:
             entity_codes=list(ds.entity_codes),
             vocabulary=ds.vocabulary,
             num_global_features=ds.num_global_features,
+            projection=ds.projection,
         )

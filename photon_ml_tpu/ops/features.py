@@ -158,3 +158,21 @@ def csr_from_scipy(mat, n_features: int | None = None, pad_to: int | None = None
         n_rows=int(mat.shape[0]),
         n_features=int(n_features if n_features is not None else mat.shape[1]),
     )
+
+
+DENSE_DENSITY_THRESHOLD = 0.2
+
+
+def features_to_device(mat, dtype=jnp.float32,
+                       dense_threshold: float = DENSE_DENSITY_THRESHOLD
+                       ) -> FeatureMatrix:
+    """Host feature matrix -> device layout, choosing dense vs CSR by
+    density. The single chooser shared by the GLM and GAME ingest paths."""
+    import scipy.sparse as sp
+
+    if sp.issparse(mat):
+        density = mat.nnz / max(1, mat.shape[0] * mat.shape[1])
+        if density >= dense_threshold:
+            return DenseFeatures(jnp.asarray(mat.toarray(), dtype))
+        return csr_from_scipy(mat, dtype=dtype)
+    return DenseFeatures(jnp.asarray(np.asarray(mat), dtype))

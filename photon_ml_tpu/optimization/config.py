@@ -157,10 +157,11 @@ class GLMOptimizationConfiguration:
         if (self.regularization_weight > 0 and
                 self.regularization_context.reg_type ==
                 RegularizationType.NONE):
-            raise ValueError(
-                f"regularization weight {self.regularization_weight} has no "
-                "effect with regularization type NONE — pass a "
-                "RegularizationContext(L1|L2|ELASTIC_NET) or weight 0")
+            # Reference semantics: under NONE the weight is simply ignored
+            # (RegularizationContext.getL1/L2RegularizationWeight return 0),
+            # so config strings like "...,10,...,NONE" and drivers with a
+            # default λ grid but --regularization-type NONE must not fail.
+            object.__setattr__(self, "regularization_weight", 0.0)
         if not (0.0 < self.down_sampling_rate <= 1.0):
             raise ValueError(
                 f"downSamplingRate must be in (0, 1], got "

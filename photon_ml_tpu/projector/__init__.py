@@ -1,0 +1,11 @@
+from photon_ml_tpu.projector.projectors import (
+    IndexMapProjector,
+    ProjectionMatrix,
+    build_random_effect_projector,
+)
+
+__all__ = [
+    "IndexMapProjector",
+    "ProjectionMatrix",
+    "build_random_effect_projector",
+]

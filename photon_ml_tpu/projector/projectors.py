@@ -1,0 +1,147 @@
+"""Feature-space projectors for random-effect coordinates.
+
+Reference: ml/projector/ — ``IndexMapProjector`` (per-entity index remap,
+IndexMapProjector.scala:42-106), ``ProjectionMatrix`` (dense Gaussian random
+projection, ProjectionMatrix.scala:90-120, broadcast wrapper
+ProjectionMatrixBroadcast.scala:30-95), and projector selection
+(RandomEffectProjector.scala:54-66).
+
+TPU-native realization:
+
+- The index-map projector is a *column gather*: each entity's observed global
+  columns become its local dense block columns, with the inverse map stored as
+  ``EntityBlock.feat_idx`` (data/random_effect.py). There is no RDD of
+  projectors — the gather indices ride along with the packed blocks.
+- The Gaussian projection matrix is a single replicated dense ``[k1, d]``
+  array; projection is one einsum against it (the analog of the reference's
+  broadcast + per-vector ``matrix * features``), applied at ingest so the
+  training blocks are already latent-space.
+
+Model conversion back to the original space (the reference's
+``projectCoefficientsRDD`` / ``RandomEffectModelInProjectedSpace``) is
+``P.T @ gamma`` for the Gaussian projector and a scatter for the index map —
+see models/random_effect.py:model_matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Union
+
+import numpy as np
+import scipy.sparse as sp
+
+RANDOM_SEED = 7  # reference: MathConst.RANDOM_SEED
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexMapProjector:
+    """Per-entity remap between global feature indices and a compact local
+    space (reference: ml/projector/IndexMapProjector.scala:42-106).
+
+    ``cols`` lists the global column for each local slot; the inverse is a
+    gather into a (-1)-extended vector.
+    """
+
+    cols: np.ndarray  # i64[d_local]: local slot -> global column
+    num_global_features: int
+
+    @property
+    def projected_space_dimension(self) -> int:
+        return len(self.cols)
+
+    @property
+    def original_space_dimension(self) -> int:
+        return self.num_global_features
+
+    def project_features(self, x: Union[np.ndarray, sp.spmatrix]
+                         ) -> np.ndarray:
+        """Gather the observed columns: [n, d_global] -> [n, d_local]."""
+        if sp.issparse(x):
+            return np.asarray(x.tocsr()[:, self.cols].todense())
+        return np.asarray(x)[:, self.cols]
+
+    def project_coefficients(self, local: np.ndarray) -> np.ndarray:
+        """Scatter local coefficients back to the global space."""
+        out = np.zeros(self.num_global_features, dtype=np.asarray(local).dtype)
+        out[self.cols] = np.asarray(local)[: len(self.cols)]
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectionMatrix:
+    """Dense projection [k1, d_global]: z = P @ x, back-projection Pᵀ @ γ
+    (reference: ml/projector/ProjectionMatrix.scala:47-62)."""
+
+    matrix: np.ndarray  # f64[k1, d_global]
+
+    @property
+    def projected_space_dimension(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def original_space_dimension(self) -> int:
+        return self.matrix.shape[1]
+
+    def project_features(self, x: Union[np.ndarray, sp.spmatrix]
+                         ) -> np.ndarray:
+        """[n, d_global] -> [n, k1] (rows are feature vectors)."""
+        if sp.issparse(x):
+            return np.asarray((x @ self.matrix.T))
+        return np.asarray(x) @ self.matrix.T
+
+    def project_coefficients(self, latent: np.ndarray) -> np.ndarray:
+        """Latent coefficients back to the original space: Pᵀ @ γ."""
+        return self.matrix.T @ np.asarray(latent)
+
+    @classmethod
+    def gaussian(
+        cls,
+        projected_space_dimension: int,
+        original_space_dimension: int,
+        intercept_col: Optional[int] = None,
+        seed: int = RANDOM_SEED,
+    ) -> "ProjectionMatrix":
+        """Gaussian random projection with the reference's scaling: entries
+        N(0, 1/k²) — i.e. std = 1/k, deliberately smaller than the
+        conventional 1/√k — clipped to [-1, 1]
+        (ProjectionMatrix.scala:96-110: ``std = projectedSpaceDimension``).
+
+        If ``intercept_col`` is given, a pass-through row is appended so the
+        intercept survives projection exactly (the reference hard-codes the
+        intercept as the last column; here it is parameterized).
+        """
+        k, d = projected_space_dimension, original_space_dimension
+        rng = np.random.default_rng(seed)
+        m = np.clip(rng.normal(0.0, 1.0, (k, d)) / k, -1.0, 1.0)
+        if intercept_col is not None:
+            m[:, intercept_col] = 0.0
+            passthrough = np.zeros((1, d))
+            passthrough[0, intercept_col] = 1.0
+            m = np.vstack([m, passthrough])
+        return cls(matrix=m)
+
+
+def build_random_effect_projector(
+    projector_type: str,
+    num_global_features: int,
+    intercept_col: Optional[int] = None,
+    seed: int = RANDOM_SEED,
+) -> Optional[ProjectionMatrix]:
+    """Projector selection (reference: RandomEffectProjector.scala:54-66).
+
+    ``INDEX_MAP`` and ``IDENTITY`` return None — both are realized directly
+    by the block packer's column gather (identity = gather of *all* columns).
+    ``RANDOM=<k>`` returns the shared Gaussian ProjectionMatrix.
+    """
+    t = projector_type.upper()
+    if t in ("INDEX_MAP", "IDENTITY"):
+        return None
+    m = re.fullmatch(r"RANDOM[=_](\d+)", t)
+    if m:
+        return ProjectionMatrix.gaussian(
+            int(m.group(1)), num_global_features, intercept_col, seed)
+    raise ValueError(
+        f"unknown projector type {projector_type!r}; expected INDEX_MAP, "
+        "IDENTITY, or RANDOM=<projected dimension>")

@@ -24,6 +24,14 @@ def setup_photon_logger(output_dir: Optional[str] = None,
     if output_dir is not None:
         path = Path(output_dir) / LOG_FILE_NAME
         path.parent.mkdir(parents=True, exist_ok=True)
+        # One job, one file: detach (and close) any file handler from a
+        # previous job in this process, so runs don't bleed into each
+        # other's log-message.txt or leak descriptors across a sweep.
+        for h in [h for h in logger.handlers
+                  if isinstance(h, logging.FileHandler)]:
+            if h.baseFilename != str(path):
+                logger.removeHandler(h)
+                h.close()
         if not any(isinstance(h, logging.FileHandler) and
                    h.baseFilename == str(path) for h in logger.handlers):
             fh = logging.FileHandler(path)

@@ -78,14 +78,14 @@ def test_identity_projector_uses_full_feature_space(rng):
             assert list(fidx[e][fidx[e] >= 0]) == list(range(d))
 
 
-def test_random_projector_raises_not_implemented(rng):
-    import pytest
-
+def test_random_projector_builds_latent_blocks(rng):
     data = GameDataset.build(
         responses=np.zeros(4),
         feature_shards={"s": sp.csr_matrix(np.ones((4, 2)))},
         ids={"userId": np.asarray(["a", "a", "b", "b"])})
-    with pytest.raises(NotImplementedError):
-        build_random_effect_dataset(
-            data, RandomEffectDataConfiguration("userId", "s",
-                                                projector_type="RANDOM=2"))
+    ds = build_random_effect_dataset(
+        data, RandomEffectDataConfiguration("userId", "s",
+                                            projector_type="RANDOM=2"))
+    assert ds.projection is not None
+    assert ds.projection.projected_space_dimension == 2
+    assert ds.projection.original_space_dimension == 2

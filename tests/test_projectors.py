@@ -1,0 +1,148 @@
+"""Projector tests: Gaussian random projection, index-map projector, and the
+projected-space random-effect training path.
+
+Mirrors the reference's ProjectionMatrixTest / IndexMapProjectorTest and the
+projected-space coordinate integration tests.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.algorithm import RandomEffectCoordinate
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_ml_tpu.projector import (
+    IndexMapProjector,
+    ProjectionMatrix,
+    build_random_effect_projector,
+)
+from photon_ml_tpu.types import TaskType
+
+import jax
+
+
+def test_gaussian_projection_matrix_semantics():
+    k, d = 8, 100
+    p = ProjectionMatrix.gaussian(k, d, intercept_col=d - 1, seed=3)
+    # Intercept pass-through row appended: [k+1, d].
+    assert p.matrix.shape == (k + 1, d)
+    assert p.projected_space_dimension == k + 1
+    # Pass-through: projecting a vector preserves the intercept exactly.
+    x = np.random.default_rng(0).normal(0, 1, d)
+    x[d - 1] = 1.0
+    z = p.project_features(x[None, :])[0]
+    assert z.shape == (k + 1,)
+    np.testing.assert_allclose(z[-1], 1.0)
+    # Entries scaled by 1/k (reference: std = projectedSpaceDimension) and
+    # clipped to [-1, 1].
+    body = p.matrix[:k, : d - 1]
+    assert np.abs(body).max() <= 1.0
+    assert np.std(body) == pytest.approx(1.0 / k, rel=0.2)
+    # Back-projection is the transpose map.
+    gamma = np.random.default_rng(1).normal(0, 1, k + 1)
+    np.testing.assert_allclose(
+        p.project_coefficients(gamma), p.matrix.T @ gamma)
+    # Score equivalence: x . (P^T gamma) == (P x) . gamma.
+    np.testing.assert_allclose(
+        x @ p.project_coefficients(gamma), z @ gamma)
+
+
+def test_index_map_projector_roundtrip():
+    cols = np.asarray([2, 5, 7])
+    proj = IndexMapProjector(cols=cols, num_global_features=10)
+    x = sp.random(4, 10, density=0.5, random_state=0, format="csr")
+    np.testing.assert_allclose(
+        proj.project_features(x), x.toarray()[:, cols])
+    local = np.asarray([1.0, -2.0, 3.0])
+    glob = proj.project_coefficients(local)
+    assert glob.shape == (10,)
+    np.testing.assert_allclose(glob[cols], local)
+    assert np.count_nonzero(glob) == 3
+
+
+def test_projector_selection():
+    assert build_random_effect_projector("INDEX_MAP", 10) is None
+    assert build_random_effect_projector("IDENTITY", 10) is None
+    p = build_random_effect_projector("RANDOM=4", 10)
+    assert isinstance(p, ProjectionMatrix)
+    assert p.matrix.shape == (4, 10)
+    with pytest.raises(ValueError):
+        build_random_effect_projector("PALDB", 10)
+
+
+def _projected_fixture(rng, n=120, d=24, n_users=6, k=4):
+    x = rng.normal(0, 1, (n, d))
+    x[:, -1] = 1.0
+    users = rng.integers(0, n_users, n)
+    bias = rng.normal(0, 2.0, n_users)
+    z = 0.3 * x[:, 0] + bias[users]
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float)
+    data = GameDataset.build(
+        responses=y,
+        feature_shards={"shard": sp.csr_matrix(x)},
+        ids={"userId": np.asarray([f"u{u}" for u in users])})
+    cfg = RandomEffectDataConfiguration(
+        "userId", "shard", projector_type=f"RANDOM={k}")
+    ds = build_random_effect_dataset(data, cfg, intercept_col=d - 1)
+    return data, ds, k
+
+
+def test_projected_dataset_blocks_are_latent(rng):
+    data, ds, k = _projected_fixture(rng)
+    assert ds.projection is not None
+    k1 = ds.projection.projected_space_dimension
+    assert k1 == k + 1  # + intercept pass-through
+    for b in ds.blocks:
+        # All blocks share the latent width (single size class).
+        assert int(np.asarray(b.feat_idx).max()) == k1 - 1
+    # Latent features equal the projection of the original rows.
+    mat = data.feature_shards["shard"].toarray()
+    b = ds.blocks[0]
+    for e in range(b.num_entities):
+        for r in range(b.n_pad):
+            gr = int(b.row_ids[e, r])
+            if gr == ds.n_rows:
+                continue
+            np.testing.assert_allclose(
+                np.asarray(b.x[e, r])[:k1],
+                ds.projection.project_features(mat[gr][None, :])[0],
+                rtol=1e-5, atol=1e-6)
+
+
+def test_projected_random_effect_training_and_back_projection(rng):
+    data, ds, k = _projected_fixture(rng)
+    coord = RandomEffectCoordinate(
+        name="perUser", dataset=ds,
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        config=GLMOptimizationConfiguration(
+            max_iterations=50, tolerance=1e-9, regularization_weight=1e-3,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2)))
+    model = coord.initialize_model()
+    assert model.projection is ds.projection
+    model, _ = coord.update_model(model, None, jax.random.key(0))
+
+    # Training in the latent space moved the model.
+    assert any(float(np.abs(np.asarray(c)).max()) > 0
+               for c in model.local_coefs)
+
+    # Back-projected global model scores == latent scores on the same rows.
+    latent_scores = np.asarray(coord.score(model))
+    global_scores = model.score_numpy(data)
+    np.testing.assert_allclose(latent_scores, global_scores,
+                               rtol=1e-4, atol=1e-5)
+
+    # model_matrix rows live in the global space.
+    m = model.model_matrix()
+    assert m.shape == (len(ds.vocabulary), data.feature_shards["shard"].shape[1])
+    assert abs(m).sum() > 0
