@@ -2,6 +2,7 @@
 
 from photon_ml_tpu.algorithm.coordinates import (
     Coordinate,
+    FactoredRandomEffectCoordinate,
     FixedEffectCoordinate,
     RandomEffectCoordinate,
 )
@@ -9,6 +10,7 @@ from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
 
 __all__ = [
     "Coordinate",
+    "FactoredRandomEffectCoordinate",
     "FixedEffectCoordinate",
     "RandomEffectCoordinate",
     "CoordinateDescent",

@@ -33,6 +33,7 @@ from photon_ml_tpu.data.sampling import down_sample_weights
 from photon_ml_tpu.models.fixed_effect import FixedEffectModel
 from photon_ml_tpu.models.glm import model_for_task
 from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.ops.features import KroneckerFeatures
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
@@ -158,19 +159,7 @@ class RandomEffectCoordinate(Coordinate):
 
     def __post_init__(self):
         if self.mesh is not None:
-            from photon_ml_tpu.parallel import shard_block
-
-            self.dataset = dataclasses.replace(
-                self.dataset,
-                blocks=[shard_block(b, self.mesh,
-                                    sentinel_row=self.dataset.n_rows)
-                        for b in self.dataset.blocks],
-                passive_blocks=[
-                    None if b is None else
-                    shard_block(b, self.mesh,
-                                sentinel_row=self.dataset.n_rows)
-                    for b in self.dataset.passive_blocks],
-            )
+            self.dataset = _shard_re_dataset(self.dataset, self.mesh)
         self._objective = GLMObjective(loss_for_task(self.task_type))
 
     def initialize_model(self) -> RandomEffectModel:
@@ -214,6 +203,208 @@ class RandomEffectCoordinate(Coordinate):
     def regularization_term(self, model: RandomEffectModel) -> float:
         return sum(regularization_term(self.config, c)
                    for c in model.local_coefs)
+
+
+def _shard_re_dataset(dataset: RandomEffectDataset, mesh
+                      ) -> RandomEffectDataset:
+    """Shard every (active + passive) bucket's entity axis over the mesh."""
+    from photon_ml_tpu.parallel import shard_block
+
+    return dataclasses.replace(
+        dataset,
+        blocks=[shard_block(b, mesh, sentinel_row=dataset.n_rows)
+                for b in dataset.blocks],
+        passive_blocks=[
+            None if b is None else
+            shard_block(b, mesh, sentinel_row=dataset.n_rows)
+            for b in dataset.passive_blocks],
+    )
+
+
+@dataclasses.dataclass
+class FactoredRandomEffectCoordinate(Coordinate):
+    """Matrix-factorization-flavored random effect
+    (ml/algorithm/FactoredRandomEffectCoordinate.scala:39-289).
+
+    Entity e's coefficients are γ_eᵀ B with γ_e ∈ R^k per entity and a
+    shared, learned B ∈ R^{k×d}. Each update alternates (reference loop at
+    :103-151):
+
+    1. per-entity latent solves — features projected through the current B
+       on device (one einsum per bucket), then the same vmap-batched solve
+       as RandomEffectCoordinate;
+    2. refit of B as a single GLM over all rows whose virtual features are
+       x_i ⊗ γ_entity(i) (reference :229-287 materializes the Kronecker
+       product per datum and shuffles it; here KroneckerFeatures contracts
+       it lazily via einsum — nothing is materialized).
+
+    The dataset must be built with the IDENTITY projector so blocks carry
+    global-width features (B itself is the dimension reduction).
+    """
+
+    name: str
+    dataset: RandomEffectDataset
+    task_type: TaskType
+    config: GLMOptimizationConfiguration  # per-entity latent solves
+    latent_config: GLMOptimizationConfiguration  # projection-matrix refit
+    mf_config: "MFOptimizationConfiguration"
+    seed: int = 7
+    mesh: Optional[object] = None
+
+    def __post_init__(self):
+        if self.dataset.projection is not None:
+            raise ValueError(
+                "FactoredRandomEffectCoordinate learns its own projection — "
+                "build the dataset with projector_type=IDENTITY")
+        d = self.dataset.num_global_features
+        for b in self.dataset.blocks:
+            if b.d_pad < d:
+                raise ValueError(
+                    "factored random effects need global-width blocks "
+                    f"(d_pad {b.d_pad} < num_global_features {d}); build "
+                    "the dataset with projector_type=IDENTITY")
+        if self.mesh is not None:
+            self.dataset = _shard_re_dataset(self.dataset, self.mesh)
+        self._objective = GLMObjective(loss_for_task(self.task_type))
+
+    @property
+    def _dtype(self):
+        return self.dataset.blocks[0].x.dtype
+
+    def initialize_model(self):
+        from photon_ml_tpu.models.factored_random_effect import (
+            FactoredRandomEffectModel,
+        )
+        from photon_ml_tpu.projector.projectors import ProjectionMatrix
+
+        ds = self.dataset
+        k = self.mf_config.num_factors
+        b0 = ProjectionMatrix.gaussian(
+            k, ds.num_global_features, intercept_col=None, seed=self.seed)
+        latent = RandomEffectModel(
+            random_effect_type=ds.config.random_effect_type,
+            feature_shard_id=ds.config.feature_shard_id,
+            local_coefs=[jnp.zeros((b.num_entities, k), self._dtype)
+                         for b in ds.blocks],
+            feat_idx=[jnp.tile(jnp.arange(k), (b.num_entities, 1))
+                      for b in ds.blocks],
+            entity_codes=list(ds.entity_codes),
+            vocabulary=ds.vocabulary,
+            num_global_features=ds.num_global_features,
+            projection=b0,
+        )
+        return FactoredRandomEffectModel(latent, self.mf_config)
+
+    def update_model(self, model, residual_scores: Optional[Array], rng_key):
+        import numpy as np
+
+        ds = self.dataset
+        d = ds.num_global_features
+        B = jnp.asarray(model.projection_matrix, self._dtype)
+        gammas = [jnp.asarray(g, self._dtype)
+                  for g in model.latent.local_coefs]
+        residuals = [_gather_residual(residual_scores, b, ds.n_rows)
+                     for b in ds.blocks]
+        # Row-major view of x/labels/offsets/weights is iteration-invariant;
+        # only the per-row gammas change across alternations.
+        x_flat, y_flat, off_flat, w_flat = _flatten_factored_static(
+            ds, residuals, d)
+        trackers = []
+        for _ in range(self.mf_config.max_iterations):
+            gammas = [
+                _solve_factored_block(
+                    self._objective, self.config, block, B, extra, g0, d).x
+                for block, extra, g0 in zip(ds.blocks, residuals, gammas)]
+            batch = GLMBatch(
+                KroneckerFeatures(x_flat, _flatten_gammas(ds, gammas)),
+                y_flat, off_flat, w_flat)
+            result = _solve_latent_matrix(
+                self._objective, self.latent_config, batch, B.reshape(-1))
+            B = result.x.reshape(B.shape)
+            trackers.append(result)
+        return model.with_update(gammas, np.asarray(B)), trackers
+
+    def score(self, model) -> Array:
+        ds = self.dataset
+        d = ds.num_global_features
+        B = jnp.asarray(model.projection_matrix, self._dtype)
+        gammas = [jnp.asarray(g, self._dtype)
+                  for g in model.latent.local_coefs]
+
+        def block_margins(block, gamma):
+            coefs = gamma @ B  # [E, d]
+            pad = block.d_pad - d
+            if pad:
+                coefs = jnp.pad(coefs, ((0, 0), (0, pad)))
+            m = block.local_margins(coefs)
+            return jnp.where(block.row_ids < ds.n_rows, m, 0.0)
+
+        margins = [block_margins(b, g) for b, g in zip(ds.blocks, gammas)]
+        passive = [None if b is None else block_margins(b, g)
+                   for b, g in zip(ds.passive_blocks, gammas)]
+        return ds.scatter_scores(margins, passive)
+
+    def regularization_term(self, model) -> float:
+        total = sum(regularization_term(self.config, g)
+                    for g in model.latent.local_coefs)
+        return total + regularization_term(
+            self.latent_config, jnp.asarray(model.projection_matrix))
+
+
+@functools.partial(jax.jit, static_argnames=("objective", "config", "d"))
+def _solve_factored_block(
+    objective: GLMObjective, config: GLMOptimizationConfiguration,
+    block: EntityBlock, B, extra_offsets, gamma0, d: int,
+):
+    """Per-entity latent solves against the current B: one projection einsum
+    for the whole bucket, then the vmapped masked solve."""
+    lat = jnp.einsum("end,kd->enk", block.x[..., :d], B)
+    offsets = block.offsets if extra_offsets is None else \
+        block.offsets + extra_offsets.astype(block.offsets.dtype)
+
+    def fit_one(g0, x_lat, y, off, w):
+        from photon_ml_tpu.ops.features import DenseFeatures
+        batch = GLMBatch(DenseFeatures(x_lat), y, off, w)
+        return solve_glm(objective, batch, config, g0)
+
+    return jax.vmap(fit_one)(gamma0, lat, block.labels, offsets,
+                             block.weights)
+
+
+def _flatten_factored_static(ds, residuals, d: int):
+    """All active rows across buckets in row-major order — the
+    iteration-invariant half of the latent-matrix refit batch (replaces the
+    reference's partitionBy-uid Kronecker shuffle,
+    FactoredRandomEffectCoordinate.scala:269-287)."""
+    xs, ys, offs, ws = [], [], [], []
+    for block, extra in zip(ds.blocks, residuals):
+        xs.append(block.x[..., :d].reshape(-1, d))
+        ys.append(block.labels.reshape(-1))
+        off = block.offsets if extra is None else \
+            block.offsets + extra.astype(block.offsets.dtype)
+        offs.append(off.reshape(-1))
+        ws.append(block.weights.reshape(-1))
+    return (jnp.concatenate(xs), jnp.concatenate(ys),
+            jnp.concatenate(offs), jnp.concatenate(ws))
+
+
+def _flatten_gammas(ds, gammas) -> Array:
+    """Per-row latent factors aligned with _flatten_factored_static's rows."""
+    gs = []
+    for block, gamma in zip(ds.blocks, gammas):
+        e, n_pad = block.labels.shape
+        k = gamma.shape[-1]
+        gs.append(jnp.broadcast_to(gamma[:, None, :], (e, n_pad, k))
+                  .reshape(-1, k))
+    return jnp.concatenate(gs)
+
+
+@functools.partial(jax.jit, static_argnames=("objective", "config"))
+def _solve_latent_matrix(
+    objective: GLMObjective, config: GLMOptimizationConfiguration,
+    batch: GLMBatch, coef0,
+):
+    return solve_glm(objective, batch, config, coef0)
 
 
 def _gather_residual(residual_scores: Optional[Array], block: EntityBlock,

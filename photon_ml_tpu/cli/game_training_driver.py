@@ -23,13 +23,17 @@ from pathlib import Path
 from photon_ml_tpu.data.avro_reader import read_game_dataset
 from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
 from photon_ml_tpu.estimators.game_estimator import (
+    FactoredRandomEffectSpec,
     FixedEffectSpec,
     GameEstimator,
     RandomEffectSpec,
 )
 from photon_ml_tpu.evaluation import build_evaluator
 from photon_ml_tpu.io.model_io import save_game_model
-from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.config import (
+    FactoredRandomEffectOptimizationConfiguration,
+    GLMOptimizationConfiguration,
+)
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
 
@@ -61,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=[], metavar="name:reDataConfig")
     p.add_argument("--random-effect-optimization-configurations", nargs="*",
                    default=[], metavar="name:optConfig[|optConfig...]")
+    p.add_argument("--factored-random-effect-data-configurations", nargs="*",
+                   default=[], metavar="name:reDataConfig")
+    p.add_argument("--factored-random-effect-optimization-configurations",
+                   nargs="*", default=[],
+                   metavar="name:reOpt;latentOpt;mfMaxIter,numFactors[|...]")
     p.add_argument("--updating-sequence", required=True,
                    help="comma-separated coordinate order")
     p.add_argument("--num-iterations", type=int, default=1)
@@ -94,15 +103,25 @@ def run(argv=None) -> dict:
             "random-effect data config").items()}
     re_opt = _parse_named(args.random_effect_optimization_configurations,
                           "random-effect optimization config")
+    fre_data = {
+        name: RandomEffectDataConfiguration.parse(cfg)
+        for name, cfg in _parse_named(
+            args.factored_random_effect_data_configurations,
+            "factored-random-effect data config").items()}
+    fre_opt = _parse_named(
+        args.factored_random_effect_optimization_configurations,
+        "factored-random-effect optimization config")
 
     sequence = [s.strip() for s in args.updating_sequence.split(",")]
     for name in sequence:
-        if name not in fe_data and name not in re_data:
+        if name not in fe_data and name not in re_data \
+                and name not in fre_data:
             raise ValueError(
                 f"updating-sequence entry {name!r} has no data configuration")
 
     id_types = sorted(
         {c.random_effect_type for c in re_data.values()} |
+        {c.random_effect_type for c in fre_data.values()} |
         {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
 
     logger.info("reading training data from %s", args.train_input_dirs)
@@ -138,6 +157,22 @@ def run(argv=None) -> dict:
                 configs=opt_grid(
                     fe_opt, name,
                     "--fixed-effect-optimization-configurations")))
+        elif name in fre_data:
+            cfg = fre_data[name]
+            if cfg.feature_shard_id not in shard_maps:
+                raise ValueError(
+                    f"factored-random-effect coordinate {name!r} references "
+                    f"unknown feature shard {cfg.feature_shard_id!r}")
+            if name not in fre_opt:
+                raise ValueError(
+                    f"coordinate {name!r} has no optimization configuration "
+                    "— pass it via "
+                    "--factored-random-effect-optimization-configurations")
+            specs.append(FactoredRandomEffectSpec(
+                name=name, data_config=cfg,
+                configs=[FactoredRandomEffectOptimizationConfiguration
+                         .parse(part)
+                         for part in fre_opt[name].split("|")]))
         else:
             cfg = re_data[name]
             if cfg.feature_shard_id not in shard_maps:

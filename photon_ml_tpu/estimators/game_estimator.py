@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.algorithm import (
     CoordinateDescent,
+    FactoredRandomEffectCoordinate,
     FixedEffectCoordinate,
     RandomEffectCoordinate,
 )
@@ -27,7 +28,10 @@ from photon_ml_tpu.data.random_effect import (
     build_random_effect_dataset,
 )
 from photon_ml_tpu.evaluation.evaluators import Evaluator
-from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.config import (
+    FactoredRandomEffectOptimizationConfiguration,
+    GLMOptimizationConfiguration,
+)
 from photon_ml_tpu.types import TaskType
 
 logger = logging.getLogger(__name__)
@@ -49,7 +53,19 @@ class RandomEffectSpec:
     intercept_col: Optional[int] = None
 
 
-CoordinateSpec = Union[FixedEffectSpec, RandomEffectSpec]
+@dataclasses.dataclass
+class FactoredRandomEffectSpec:
+    """Factored random effect: per-entity latent factors + learned shared
+    projection matrix. data_config must use the IDENTITY projector (B itself
+    is the dimension reduction)."""
+
+    name: str
+    data_config: RandomEffectDataConfiguration
+    configs: Sequence["FactoredRandomEffectOptimizationConfiguration"]
+
+
+CoordinateSpec = Union[FixedEffectSpec, RandomEffectSpec,
+                       FactoredRandomEffectSpec]
 
 
 class GameEstimator:
@@ -82,11 +98,24 @@ class GameEstimator:
     ) -> List[Tuple[Dict[str, GLMOptimizationConfiguration],
                     CoordinateDescentResult]]:
         """Train one model per per-coordinate config combination."""
+        def _re_dataset(s):
+            cfg = s.data_config
+            if isinstance(s, FactoredRandomEffectSpec):
+                # Factored coordinates learn their own projection — blocks
+                # must carry global-width features regardless of the config's
+                # projector field, so Pearson column trimming is off too.
+                cfg = dataclasses.replace(
+                    cfg, projector_type="IDENTITY",
+                    num_features_to_samples_ratio=None)
+            return build_random_effect_dataset(
+                data, cfg, seed=seed,
+                intercept_col=(s.intercept_col
+                               if isinstance(s, RandomEffectSpec) else None),
+                dtype=self.dtype)
+
         re_datasets = {
-            s.name: build_random_effect_dataset(
-                data, s.data_config, seed=seed,
-                intercept_col=s.intercept_col, dtype=self.dtype)
-            for s in self.specs if isinstance(s, RandomEffectSpec)}
+            s.name: _re_dataset(s) for s in self.specs
+            if isinstance(s, (RandomEffectSpec, FactoredRandomEffectSpec))}
 
         combos = itertools.product(
             *[[(s.name, c) for c in s.configs] for s in self.specs])
@@ -102,6 +131,14 @@ class GameEstimator:
                         task_type=self.task_type, config=configs[s.name],
                         normalization=s.normalization, dtype=self.dtype,
                         mesh=self.mesh)
+                elif isinstance(s, FactoredRandomEffectSpec):
+                    cfg = configs[s.name]
+                    coords[s.name] = FactoredRandomEffectCoordinate(
+                        name=s.name, dataset=re_datasets[s.name],
+                        task_type=self.task_type,
+                        config=cfg.random_effect,
+                        latent_config=cfg.latent_factor,
+                        mf_config=cfg.mf, seed=seed, mesh=self.mesh)
                 else:
                     coords[s.name] = RandomEffectCoordinate(
                         name=s.name, dataset=re_datasets[s.name],

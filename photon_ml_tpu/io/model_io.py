@@ -33,6 +33,9 @@ from photon_ml_tpu.models.glm import (
     model_class_by_name,
     model_for_task,
 )
+from photon_ml_tpu.models.factored_random_effect import (
+    FactoredRandomEffectModel,
+)
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
 from photon_ml_tpu.types import TaskType
@@ -189,12 +192,18 @@ def save_game_model(
             meta["coordinates"].append({
                 "name": name, "kind": "fixed",
                 "featureShardId": model.feature_shard_id})
-        elif isinstance(model, (RandomEffectModel, RandomEffectModelSnapshot)):
+        elif isinstance(model, (RandomEffectModel, RandomEffectModelSnapshot,
+                                FactoredRandomEffectModel)):
+            # Factored models persist in the ORIGINAL feature space, exactly
+            # like the reference (projected-space models are converted before
+            # saving, ModelProcessingUtils.saveGameModelsToHDFS) — they load
+            # back as plain random-effect models.
             d = root / RANDOM_DIR / name / COEFF_DIR
             d.mkdir(parents=True, exist_ok=True)
             imap = index_maps[model.feature_shard_id]
             glm_cls = model_for_task(game_model.task_type)
-            if isinstance(model, RandomEffectModel):
+            if isinstance(model, (RandomEffectModel,
+                                  FactoredRandomEffectModel)):
                 entity_rows = model.to_entity_dict()
             else:
                 m = model.matrix
@@ -232,7 +241,10 @@ def save_game_model(
             (d / ID_INFO_FILE).write_text(json.dumps({
                 "rowEffectType": model.row_effect_type,
                 "colEffectType": model.col_effect_type}))
-            meta["coordinates"].append({"name": name, "kind": "mf"})
+            meta["coordinates"].append({
+                "name": name, "kind": "mf",
+                "rowEffectType": model.row_effect_type,
+                "colEffectType": model.col_effect_type})
         else:
             raise TypeError(f"cannot save model type {type(model)}")
     (root / METADATA_FILE).write_text(json.dumps(meta, indent=2))

@@ -11,6 +11,7 @@ from photon_ml_tpu.models.glm import (
 )
 from photon_ml_tpu.models.fixed_effect import FixedEffectModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.models.factored_random_effect import FactoredRandomEffectModel
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.models.game_model import GameModel
 
@@ -24,6 +25,7 @@ __all__ = [
     "model_for_task",
     "FixedEffectModel",
     "RandomEffectModel",
+    "FactoredRandomEffectModel",
     "MatrixFactorizationModel",
     "GameModel",
 ]

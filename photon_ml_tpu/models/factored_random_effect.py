@@ -1,0 +1,68 @@
+"""Factored random-effect model: per-entity latent factors + a shared,
+*learned* projection matrix B (reference: ml/model/FactoredRandomEffectModel.scala,
+which pairs projected-space models with a broadcast ProjectionMatrix).
+
+Entity e's effective global coefficients are γ_eᵀ B — the model IS a
+RandomEffectModel living in the latent space, with the learned B as its
+projection, so scoring / persistence / global-space conversion all reuse
+that machinery (models/random_effect.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.optimization.config import MFOptimizationConfiguration
+from photon_ml_tpu.projector.projectors import ProjectionMatrix
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectModel:
+    latent: RandomEffectModel  # local_coefs = γ per entity; projection = B
+    mf_config: MFOptimizationConfiguration
+
+    def __post_init__(self):
+        if self.latent.projection is None:
+            raise ValueError(
+                "FactoredRandomEffectModel requires a latent RandomEffectModel "
+                "with its learned projection matrix attached")
+
+    @property
+    def projection_matrix(self) -> np.ndarray:
+        """The learned B: [num_factors, num_global_features]."""
+        return self.latent.projection.matrix
+
+    @property
+    def random_effect_type(self) -> str:
+        return self.latent.random_effect_type
+
+    @property
+    def feature_shard_id(self) -> str:
+        return self.latent.feature_shard_id
+
+    @property
+    def num_entities(self) -> int:
+        return self.latent.num_entities
+
+    def with_update(self, local_coefs: List, matrix: np.ndarray
+                    ) -> "FactoredRandomEffectModel":
+        latent = dataclasses.replace(
+            self.latent, local_coefs=list(local_coefs),
+            projection=ProjectionMatrix(matrix=np.asarray(matrix)))
+        return dataclasses.replace(self, latent=latent)
+
+    # Global-space views / scoring delegate to the latent model, whose
+    # projection handles the γᵀB conversion.
+
+    def model_matrix(self):
+        return self.latent.model_matrix()
+
+    def to_entity_dict(self):
+        return self.latent.to_entity_dict()
+
+    def score_numpy(self, data) -> np.ndarray:
+        return self.latent.score_numpy(data)

@@ -131,7 +131,63 @@ class CSRFeatures:
         return cls(*children, *aux)
 
 
-FeatureMatrix = Union[DenseFeatures, CSRFeatures]
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class KroneckerFeatures:
+    """Lazy row-wise Kronecker product: virtual row i = vec(γ_i ⊗ x_i).
+
+    The latent-matrix refit of a factored random effect solves a GLM whose
+    coefficient vector is the flattened projection matrix B[k, d] and whose
+    features are x_i ⊗ γ_entity(i) (reference:
+    ml/algorithm/FactoredRandomEffectCoordinate.scala:269-287, which
+    materializes the product per datum and shuffles it). Here the product is
+    never materialized: every matvec/rmatvec contracts through einsum, so the
+    MXU sees [n,d]x[k,d] contractions instead of an [n, k*d] blow-up.
+
+    Flattening convention: coefficient index (a, j) -> a * d + j, i.e.
+    ``B.reshape(-1)`` of a [k, d] matrix.
+    """
+
+    x: Array  # f[n, d]
+    gamma: Array  # f[n, k]
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.x.shape[0], self.num_features)
+
+    @property
+    def num_features(self) -> int:
+        return self.gamma.shape[-1] * self.x.shape[-1]
+
+    def _as_matrix(self, v: Array) -> Array:
+        return v.reshape(self.gamma.shape[-1], self.x.shape[-1])
+
+    def matvec(self, v: Array) -> Array:
+        """margin_i = γ_iᵀ B x_i."""
+        return jnp.einsum("nd,kd,nk->n", self.x, self._as_matrix(v),
+                          self.gamma)
+
+    def rmatvec(self, u: Array) -> Array:
+        """Σ_i u_i γ_i x_iᵀ, flattened."""
+        return jnp.einsum("n,nk,nd->kd", u, self.gamma, self.x).reshape(-1)
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        return jnp.einsum("nd,kd,nk->n", jnp.square(self.x),
+                          self._as_matrix(v), jnp.square(self.gamma))
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        return jnp.einsum("n,nk,nd->kd", u, jnp.square(self.gamma),
+                          jnp.square(self.x)).reshape(-1)
+
+    def tree_flatten(self):
+        return (self.x, self.gamma), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+FeatureMatrix = Union[DenseFeatures, CSRFeatures, KroneckerFeatures]
 
 
 def csr_from_scipy(mat, n_features: int | None = None, pad_to: int | None = None,

@@ -23,6 +23,7 @@ from photon_ml_tpu.optimization.config import (
     OptimizerConfig,
     RegularizationContext,
     GLMOptimizationConfiguration,
+    MFOptimizationConfiguration,
 )
 
 __all__ = [
@@ -36,4 +37,5 @@ __all__ = [
     "OptimizerConfig",
     "RegularizationContext",
     "GLMOptimizationConfiguration",
+    "MFOptimizationConfiguration",
 ]

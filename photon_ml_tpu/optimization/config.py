@@ -229,6 +229,87 @@ class GLMOptimizationConfiguration:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class MFOptimizationConfiguration:
+    """Matrix-factorization knobs for factored random effects.
+
+    String form "maxIter,numFactors"
+    (reference: ml/optimization/game/MFOptimizationConfiguration.scala:23-50):
+    ``max_iterations`` alternations between the per-entity latent solves and
+    the projection-matrix refit per coordinate update; ``num_factors`` is the
+    latent dimension of the shared projection matrix.
+    """
+
+    max_iterations: int = 1
+    num_factors: int = 5
+
+    def __post_init__(self):
+        if self.max_iterations <= 0:
+            raise ValueError(
+                f"maxIterations must be positive, got {self.max_iterations}")
+        if self.num_factors <= 0:
+            raise ValueError(
+                f"numFactors must be positive, got {self.num_factors}")
+
+    @classmethod
+    def parse(cls, s: str) -> "MFOptimizationConfiguration":
+        parts = [p.strip() for p in s.split(",") if p.strip()]
+        if len(parts) != 2:
+            raise ValueError(
+                f"expected 'maxNumberIterations,numFactors', got {s!r}")
+        return cls(max_iterations=int(parts[0]), num_factors=int(parts[1]))
+
+    def to_string(self) -> str:
+        return f"{self.max_iterations},{self.num_factors}"
+
+    def to_json(self) -> Dict:
+        return {"maxIterations": self.max_iterations,
+                "numFactors": self.num_factors}
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "MFOptimizationConfiguration":
+        return cls(d["maxIterations"], d["numFactors"])
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectOptimizationConfiguration:
+    """The config triple of a factored random effect (reference:
+    FactoredRandomEffectOptimizationProblem — a random-effect problem, a
+    latent-factor problem, and the MF knobs). String form joins the three
+    with ';': 'reCfg;latentCfg;maxIter,numFactors'."""
+
+    random_effect: GLMOptimizationConfiguration
+    latent_factor: GLMOptimizationConfiguration
+    mf: MFOptimizationConfiguration
+
+    @classmethod
+    def parse(cls, s: str) -> "FactoredRandomEffectOptimizationConfiguration":
+        parts = s.split(";")
+        if len(parts) != 3:
+            raise ValueError(
+                "expected 'reOptConfig;latentOptConfig;mfConfig' "
+                f"(';'-separated), got {s!r}")
+        return cls(GLMOptimizationConfiguration.parse(parts[0]),
+                   GLMOptimizationConfiguration.parse(parts[1]),
+                   MFOptimizationConfiguration.parse(parts[2]))
+
+    def to_string(self) -> str:
+        return (f"{self.random_effect.to_string()};"
+                f"{self.latent_factor.to_string()};{self.mf.to_string()}")
+
+    def to_json(self) -> Dict:
+        return {"randomEffect": self.random_effect.to_json(),
+                "latentFactor": self.latent_factor.to_json(),
+                "mf": self.mf.to_json()}
+
+    @classmethod
+    def from_json(cls, d: Dict
+                  ) -> "FactoredRandomEffectOptimizationConfiguration":
+        return cls(GLMOptimizationConfiguration.from_json(d["randomEffect"]),
+                   GLMOptimizationConfiguration.from_json(d["latentFactor"]),
+                   MFOptimizationConfiguration.from_json(d["mf"]))
+
+
 def parse_constraint_string(s: str, index_map) -> ConstraintMap:
     """Parse the box-constraint JSON of the reference
     (ml/io/GLMSuite.scala:207-260): a list of

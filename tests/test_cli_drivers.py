@@ -235,3 +235,44 @@ def test_game_driver_rejects_unknown_sequence_entry(tmp_path, rng):
             "fixed:10,1e-6,1.0,1.0,LBFGS,L2",
             "--updating-sequence", "fixed,ghost",
         ])
+
+
+def test_game_training_with_factored_random_effect(tmp_path, rng):
+    train = tmp_path / "train"
+    valid = tmp_path / "valid"
+    params = (rng.normal(0, 1.5, 10), rng.normal(0, 1, 3))
+    _write_game_avro(train, rng, n=300, params=params)
+    _write_game_avro(valid, rng, n=120, params=params)
+    out = tmp_path / "out"
+    summary = game_training_driver.run([
+        "--train-input-dirs", str(train),
+        "--validate-input-dirs", str(valid),
+        "--output-dir", str(out),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:20,1e-7,1.0,1.0,LBFGS,L2",
+        "--factored-random-effect-data-configurations",
+        "perUserMF:userId,global,4,-1,-1,-1",
+        "--factored-random-effect-optimization-configurations",
+        "perUserMF:15,1e-7,1.0,1.0,LBFGS,L2;15,1e-7,1.0,1.0,LBFGS,L2;2,2",
+        "--updating-sequence", "fixed,perUserMF",
+        "--num-iterations", "2",
+        "--evaluators", "AUC",
+    ])
+    assert summary["validationHistory"][-1]["AUC"] > 0.6
+    meta = json.loads((out / "best" / "model-metadata.json").read_text())
+    kinds = {c["name"]: c["kind"] for c in meta["coordinates"]}
+    # Factored models persist as original-space random-effect coordinates.
+    assert kinds == {"fixed": "fixed", "perUserMF": "random"}
+
+    score_out = tmp_path / "score-out"
+    score_summary = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(out / "best"),
+        "--output-dir", str(score_out),
+        "--evaluators", "AUC",
+    ])
+    np.testing.assert_allclose(
+        score_summary["metrics"]["AUC"],
+        summary["validationHistory"][-1]["AUC"], atol=1e-6)
