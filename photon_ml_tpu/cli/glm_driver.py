@@ -25,6 +25,18 @@ from photon_ml_tpu.data.libsvm import read_libsvm
 from photon_ml_tpu.data.normalization import build_normalization_context
 from photon_ml_tpu.data.stats import BasicStatisticalSummary
 from photon_ml_tpu.data.validators import validate_data
+from photon_ml_tpu.diagnostics import (
+    DiagnosticMode,
+    DiagnosticReport,
+    bootstrap_training,
+    expected_magnitude_importance,
+    fitting_diagnostic,
+    hosmer_lemeshow_diagnostic,
+    prediction_error_independence,
+    variance_importance,
+    write_report,
+)
+from photon_ml_tpu.diagnostics.reporting import ModelDiagnosticReport
 from photon_ml_tpu.estimators.model_selection import select_best_model
 from photon_ml_tpu.estimators.model_training import train_glm_models
 from photon_ml_tpu.evaluation.validation import evaluate_glm
@@ -48,7 +60,7 @@ from photon_ml_tpu.utils.events import EventEmitter
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
 from photon_ml_tpu.utils.timer import PhaseTimer
 
-STAGES = ["INIT", "PREPROCESSED", "TRAINED", "VALIDATED"]
+STAGES = ["INIT", "PREPROCESSED", "TRAINED", "VALIDATED", "DIAGNOSED"]
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -79,6 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="JSON constraint string (GLMSuite format)")
     p.add_argument("--validate-data", default="VALIDATE_FULL",
                    choices=[t.value for t in DataValidationType])
+    p.add_argument("--diagnostic-mode", default="NONE",
+                   choices=["NONE", "TRAIN", "VALIDATE", "ALL"],
+                   help="which diagnostics to run "
+                        "(ml/diagnostics/DiagnosticMode.scala)")
+    p.add_argument("--num-bootstrap-samples", type=int, default=4)
     p.add_argument("--compute-variance", default="false",
                    choices=["true", "false"])
     p.add_argument("--warm-start", default="true", choices=["true", "false"])
@@ -127,6 +144,97 @@ def _load(path: str, fmt: str, add_intercept: bool, task: TaskType,
     y = np.concatenate(ys)
     imap = IdentityIndexMap(mat.shape[1], intercept_last=add_intercept)
     return mat, y, np.zeros(len(y)), np.ones(len(y)), imap
+
+
+def _run_diagnostics(mode, out_dir, task, trained, metrics_by_lambda,
+                     mat, y, off, w, imap, vdata, train_kwargs,
+                     num_bootstrap_samples):
+    """DIAGNOSED stage (reference: ml/Driver.scala:524-551 — training
+    diagnostics run against training data, validation diagnostics against
+    the validation set; everything lands in one report document)."""
+    summary = BasicStatisticalSummary.compute(mat)
+    feature_names = [imap.get_feature_name(i) or str(i)
+                     for i in range(mat.shape[1])]
+    lambdas = list(train_kwargs["regularization_weights"])
+
+    def subset_trainer(train_idx, holdout_idx, warm, eval_train=True):
+        """(λ, model, train metrics, holdout metrics) per grid point —
+        the curried trainModel closure of BootstrapTraining/FittingDiagnostic.
+        eval_train=False skips the train-split scoring pass (bootstrap only
+        consumes holdout metrics)."""
+        init = warm.get(max(lambdas)) if warm else None
+        results = train_glm_models(
+            mat[train_idx], y[train_idx], task,
+            offsets=off[train_idx], weights=w[train_idx],
+            initial_model=init,
+            **train_kwargs)
+        out = []
+        for t in results:
+            means, _ = t.model.coefficients.to_numpy()
+            train_metrics = {}
+            if eval_train:
+                train_scores = np.asarray(mat[train_idx] @ means).ravel()
+                train_metrics = evaluate_glm(
+                    task, train_scores, y[train_idx],
+                    off[train_idx], w[train_idx])
+            hold_scores = np.asarray(mat[holdout_idx] @ means).ravel()
+            out.append((
+                t.reg_weight, t.model, train_metrics,
+                evaluate_glm(task, hold_scores, y[holdout_idx],
+                             off[holdout_idx], w[holdout_idx])))
+        return out
+
+    fitting_by_lambda = {}
+    bootstrap_by_lambda = {}
+    if mode.train_enabled:
+        fitting_by_lambda = fitting_diagnostic(
+            mat.shape[0], mat.shape[1], subset_trainer)
+        if num_bootstrap_samples > 1:
+            def bootstrap_trainer(train_idx, holdout_idx, warm):
+                return [(lam, model, hold)
+                        for lam, model, _, hold
+                        in subset_trainer(train_idx, holdout_idx, warm,
+                                          eval_train=False)]
+
+            bootstrap_by_lambda = bootstrap_training(
+                mat.shape[0], bootstrap_trainer,
+                num_bootstrap_samples=num_bootstrap_samples)
+
+    report = DiagnosticReport(system={
+        "task": task.value,
+        "numRows": int(mat.shape[0]),
+        "numFeatures": int(mat.shape[1]),
+        "lambdas": lambdas,
+        "diagnosticMode": mode.value,
+    })
+    for t in trained:
+        means, _ = t.model.coefficients.to_numpy()
+        chapter = ModelDiagnosticReport(
+            model_description=t.model.model_class_name,
+            reg_weight=t.reg_weight,
+            metrics=metrics_by_lambda.get(t.reg_weight, {}))
+        chapter.feature_importance = [
+            expected_magnitude_importance(
+                means, summary, feature_names).to_dict(),
+            variance_importance(means, summary, feature_names).to_dict(),
+        ]
+        if t.reg_weight in fitting_by_lambda:
+            chapter.fitting = fitting_by_lambda[t.reg_weight].to_dict()
+        if t.reg_weight in bootstrap_by_lambda:
+            chapter.bootstrap = bootstrap_by_lambda[t.reg_weight].to_dict()
+        if mode.validate_enabled and vdata is not None:
+            vmat, vy, voff, vw = vdata
+            vscores = np.asarray(vmat @ means).ravel() + voff
+            predictions = np.asarray(
+                t.model.mean_of_score(vscores))
+            chapter.prediction_error_independence = \
+                prediction_error_independence(vy, predictions).to_dict()
+            if task == TaskType.LOGISTIC_REGRESSION:
+                chapter.hosmer_lemeshow = hosmer_lemeshow_diagnostic(
+                    vy, predictions, vmat.shape[1]).to_dict()
+        report.models.append(chapter)
+
+    write_report(report, out_dir)
 
 
 def run(argv=None) -> dict:
@@ -227,6 +335,27 @@ def run(argv=None) -> dict:
                 json.dumps({str(k): v for k, v in metrics_by_lambda.items()},
                            indent=2))
         stages.append("VALIDATED")
+
+    # ---- diagnose --------------------------------------------------------
+    diag_mode = DiagnosticMode(args.diagnostic_mode)
+    if diag_mode is not DiagnosticMode.NONE:
+        with timer.time("diagnose"):
+            _run_diagnostics(
+                diag_mode, out_dir, task, trained, metrics_by_lambda,
+                mat, y, off, w, imap,
+                vdata=(vmat, vy, voff, vw)
+                if args.validating_data_directory else None,
+                train_kwargs=dict(
+                    regularization_weights=lambdas,
+                    regularization_context=reg_ctx,
+                    optimizer_type=OptimizerType(args.optimizer),
+                    max_iterations=args.max_num_iterations,
+                    tolerance=args.tolerance, normalization=norm,
+                    lower_bounds=lb, upper_bounds=ub,
+                    warm_start=args.warm_start == "true", dtype=dtype),
+                num_bootstrap_samples=args.num_bootstrap_samples)
+        stages.append("DIAGNOSED")
+        logger.info("diagnostics written to model-diagnostic.{json,html}")
 
     # ---- write models ----------------------------------------------------
     with timer.time("write"):

@@ -1,0 +1,153 @@
+"""Bootstrap training and coefficient/metric confidence intervals
+(reference: ml/BootstrapTraining.scala:28-180 and
+ml/supervised/model/CoefficientSummary.scala).
+
+The reference tags rows into 1000 splits, shuffles split ids per bootstrap
+draw, and re-trains a λ-grid on each draw; aggregates are per-coefficient
+and per-metric streaming summaries. Here each draw is a row-index subset fed
+back through the jitted λ-grid solve, so all draws share one compiled
+kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Mapping, Sequence, Tuple
+
+import numpy as np
+
+# A trainer maps (train_indices, holdout_indices, warm_start by λ) to
+# [(λ, model, holdout_metrics)]. The driver curries train_glm_models +
+# evaluate_glm into this shape, the analog of the reference's curried
+# trainModel closure plus Evaluation.evaluate on the holdout
+# (ml/BootstrapTraining.scala:132-140,158-161).
+TrainFn = Callable[[np.ndarray, np.ndarray, Mapping[float, object]],
+                   List[Tuple[float, object, Dict[str, float]]]]
+
+# Never use more than 90% of the splits for training, matching the
+# reference's guard (ml/BootstrapTraining.scala:146-149).
+_NUM_SPLITS = 1000
+_MAX_TRAIN_SPLITS = 900
+
+
+@dataclasses.dataclass
+class CoefficientSummary:
+    """Streaming min/max/mean/variance summary of one scalar across
+    bootstrap models (reference: ml/supervised/model/CoefficientSummary.scala)."""
+
+    count: int = 0
+    mean: float = 0.0
+    m2: float = 0.0
+    min: float = float("inf")
+    max: float = float("-inf")
+
+    def accumulate(self, x: float) -> None:
+        x = float(x)
+        self.count += 1
+        delta = x - self.mean
+        self.mean += delta / self.count
+        self.m2 += delta * (x - self.mean)
+        self.min = min(self.min, x)
+        self.max = max(self.max, x)
+
+    @property
+    def variance(self) -> float:
+        return self.m2 / (self.count - 1) if self.count > 1 else 0.0
+
+    @property
+    def std_dev(self) -> float:
+        return float(np.sqrt(self.variance))
+
+    def to_dict(self) -> Dict[str, float]:
+        return {"count": self.count, "mean": self.mean, "min": self.min,
+                "max": self.max, "stdDev": self.std_dev}
+
+
+def aggregate_coefficient_confidence_intervals(
+    models_and_metrics: Sequence[Tuple[object, Dict[str, float]]],
+) -> List[CoefficientSummary]:
+    """Per-coefficient summaries across bootstrap models, 1:1 with the
+    coefficient vector (ml/BootstrapTraining.scala:46-70)."""
+    summaries: List[CoefficientSummary] = []
+    for model, _ in models_and_metrics:
+        means = np.asarray(model.coefficients.means)
+        if not summaries:
+            summaries = [CoefficientSummary() for _ in range(len(means))]
+        for s, value in zip(summaries, means):
+            s.accumulate(value)
+    return summaries
+
+
+def aggregate_metrics_confidence_intervals(
+    models_and_metrics: Sequence[Tuple[object, Dict[str, float]]],
+) -> Dict[str, CoefficientSummary]:
+    """Per-metric summaries across bootstrap holdout evaluations
+    (ml/BootstrapTraining.scala:90-99)."""
+    out: Dict[str, CoefficientSummary] = {}
+    for _, metrics in models_and_metrics:
+        for name, value in metrics.items():
+            out.setdefault(name, CoefficientSummary()).accumulate(value)
+    return out
+
+
+@dataclasses.dataclass
+class BootstrapReport:
+    """Aggregates for one λ."""
+
+    coefficient_intervals: List[CoefficientSummary]
+    metric_intervals: Dict[str, CoefficientSummary]
+    num_models: int
+
+    def to_dict(self) -> Dict:
+        return {
+            "numModels": self.num_models,
+            "metricIntervals": {k: v.to_dict()
+                                for k, v in self.metric_intervals.items()},
+            "coefficientIntervals": [s.to_dict()
+                                     for s in self.coefficient_intervals],
+        }
+
+
+def bootstrap_training(
+    num_rows: int,
+    train_fn: TrainFn,
+    num_bootstrap_samples: int = 4,
+    population_portion: float = 0.9,
+    warm_start: Mapping[float, object] | None = None,
+    seed: int = 0,
+) -> Dict[float, BootstrapReport]:
+    """Draw bootstrap train/holdout splits, re-train the λ grid per draw,
+    and aggregate coefficient + metric confidence intervals per λ
+    (ml/BootstrapTraining.scala:120-180). Split mechanics follow the
+    reference: rows tagged into 1000 uniform splits once; each draw
+    shuffles split ids and takes min(900, portion·1000) of them."""
+    if num_bootstrap_samples <= 1:
+        raise ValueError(
+            f"need >1 bootstrap samples, got {num_bootstrap_samples}")
+    if not 0.0 < population_portion <= 1.0:
+        raise ValueError(
+            f"population portion must be in (0, 1], got {population_portion}")
+
+    rng = np.random.default_rng(seed)
+    tags = rng.integers(0, _NUM_SPLITS, num_rows)
+    target_splits = min(_MAX_TRAIN_SPLITS,
+                        int(population_portion * _NUM_SPLITS))
+    warm = dict(warm_start or {})
+
+    per_lambda: Dict[float, List[Tuple[object, Dict[str, float]]]] = {}
+    for _ in range(num_bootstrap_samples):
+        shuffled = rng.permutation(_NUM_SPLITS)
+        train_mask = np.isin(tags, shuffled[:target_splits])
+        train_idx = np.flatnonzero(train_mask)
+        holdout_idx = np.flatnonzero(~train_mask)
+        for lam, model, metrics in train_fn(train_idx, holdout_idx, warm):
+            per_lambda.setdefault(lam, []).append((model, metrics))
+
+    return {
+        lam: BootstrapReport(
+            coefficient_intervals=
+            aggregate_coefficient_confidence_intervals(mm),
+            metric_intervals=aggregate_metrics_confidence_intervals(mm),
+            num_models=len(mm))
+        for lam, mm in per_lambda.items()
+    }

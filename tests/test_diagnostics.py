@@ -1,0 +1,227 @@
+"""Diagnostics subsystem tests (reference test strategy: SURVEY.md §4 —
+statistics checked against closed forms / scipy; driver wiring checked
+end-to-end on tiny synthetic data)."""
+
+import json
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from photon_ml_tpu.data.stats import BasicStatisticalSummary
+from photon_ml_tpu.diagnostics import (
+    DiagnosticReport,
+    bootstrap_training,
+    expected_magnitude_importance,
+    fitting_diagnostic,
+    hosmer_lemeshow_diagnostic,
+    kendall_tau_analysis,
+    prediction_error_independence,
+    render_html_report,
+    variance_importance,
+)
+from photon_ml_tpu.diagnostics.reporting import (
+    DiagnosticMode,
+    ModelDiagnosticReport,
+)
+
+
+def test_kendall_tau_matches_scipy(rng):
+    a = rng.normal(size=200)
+    b = 0.5 * a + rng.normal(size=200)
+    report = kendall_tau_analysis(a, b)
+    expected = scipy.stats.kendalltau(a, b, variant="b").statistic
+    assert report.tau_beta == pytest.approx(expected, abs=1e-12)
+    assert report.num_pairs == 200 * 199 // 2
+    assert report.num_concordant + report.num_discordant == \
+        report.effective_pairs
+
+
+def test_kendall_tau_independent_vs_dependent(rng):
+    a = rng.normal(size=500)
+    independent = kendall_tau_analysis(a, rng.normal(size=500))
+    dependent = kendall_tau_analysis(a, a + 0.01 * rng.normal(size=500))
+    assert abs(independent.tau_alpha) < 0.1
+    assert dependent.tau_alpha > 0.9
+    # Two-sided p-value: tiny under strong dependence, large-ish when
+    # independent; the reference's P(|Z|<=z) is kept as `confidence`.
+    assert dependent.p_value < 1e-6
+    assert dependent.confidence > 0.99
+    assert independent.p_value > 0.01
+
+
+def test_kendall_tau_tie_accounting():
+    report = kendall_tau_analysis([1.0, 1.0, 2.0], [1.0, 2.0, 3.0])
+    # Pair (0,1) ties in a; pairs (0,2) and (1,2) are concordant.
+    assert report.num_concordant == 2
+    assert report.num_discordant == 0
+    assert "ties" in report.message.lower()
+
+
+def test_prediction_error_independence_samples_capped(rng):
+    labels = rng.normal(size=8000)
+    preds = labels + rng.normal(size=8000)
+    report = prediction_error_independence(labels, preds)
+    assert len(report.predictions) == 5000
+    assert report.kendall_tau.num_items == 5000
+
+
+def test_hosmer_lemeshow_calibrated_vs_miscalibrated(rng):
+    n = 4000
+    p = rng.uniform(0.05, 0.95, n)
+    y = (rng.random(n) < p).astype(float)
+    good = hosmer_lemeshow_diagnostic(y, p, num_dimensions=8)
+    bad = hosmer_lemeshow_diagnostic(y, np.clip(p * 0.4, 0, 1),
+                                     num_dimensions=8)
+    assert bad.chi_square > good.chi_square
+    assert good.degrees_of_freedom == len(good.bins) - 2
+    # All rows land in exactly one bin.
+    assert sum(b.total for b in good.bins) == n
+    # Midpoint-based expected counts conserve totals.
+    for b in good.bins:
+        assert b.expected_pos + b.expected_neg == b.total
+    d = good.to_dict()
+    assert d["pValue"] == pytest.approx(1.0 - d["probAtChiSquare"])
+
+
+def test_feature_importance_ranking(rng):
+    x = rng.normal(0, 1, (500, 4))
+    x[:, 2] *= 10.0  # large spread -> large meanAbs and variance
+    summary = BasicStatisticalSummary.compute(x)
+    coef = np.array([0.1, 0.1, 0.1, 0.1])
+    names = ["a", "b", "big", "d"]
+
+    em = expected_magnitude_importance(coef, summary, names)
+    assert em.ranked_features[0][0] == "big"
+    var = variance_importance(coef, summary, names)
+    assert var.ranked_features[0][0] == "big"
+    # Without a summary both collapse to |coef|.
+    em_plain = expected_magnitude_importance(np.array([1.0, -3.0]), None,
+                                             ["u", "v"])
+    assert em_plain.ranked_features[0][0] == "v"
+    assert em_plain.ranked_features[0][2] == pytest.approx(3.0)
+
+
+def _toy_trainer(x, y, lam_grid):
+    """Closed-form ridge per λ — a stand-in for train_glm_models."""
+
+    class Model:
+        def __init__(self, means):
+            self.coefficients = type("C", (), {"means": means})()
+
+    def train(train_idx, holdout_idx, warm):
+        out = []
+        for lam in lam_grid:
+            xt, yt = x[train_idx], y[train_idx]
+            w = np.linalg.solve(xt.T @ xt + lam * np.eye(x.shape[1]),
+                                xt.T @ yt)
+            def mse(idx):
+                r = x[idx] @ w - y[idx]
+                return {"MSE": float(r @ r / max(len(idx), 1))}
+            out.append((lam, Model(w), mse(train_idx), mse(holdout_idx)))
+        return out
+
+    return train
+
+
+def test_fitting_diagnostic_learning_curves(rng):
+    n, d = 2000, 3
+    x = rng.normal(size=(n, d))
+    y = x @ np.array([1.0, -2.0, 0.5]) + 0.1 * rng.normal(size=n)
+    reports = fitting_diagnostic(n, d, _toy_trainer(x, y, [1.0]))
+    assert set(reports) == {1.0}
+    portions, train, holdout = reports[1.0].metrics["MSE"]
+    assert len(portions) == 9  # fractions 10%..90%
+    assert portions == sorted(portions)
+    # More data shrinks the generalization gap.
+    assert abs(holdout[-1] - train[-1]) <= abs(holdout[0] - train[0]) + 0.05
+
+
+def test_fitting_diagnostic_too_small_returns_empty(rng):
+    assert fitting_diagnostic(50, 10, _toy_trainer(
+        rng.normal(size=(50, 10)), rng.normal(size=50), [1.0])) == {}
+
+
+def test_bootstrap_confidence_intervals(rng):
+    n, d = 1200, 3
+    true_w = np.array([2.0, -1.0, 0.0])
+    x = rng.normal(size=(n, d))
+    y = x @ true_w + 0.5 * rng.normal(size=n)
+    trainer = _toy_trainer(x, y, [0.1])
+
+    def bs_trainer(train_idx, holdout_idx, warm):
+        return [(lam, m, hold)
+                for lam, m, _, hold in trainer(train_idx, holdout_idx, warm)]
+
+    reports = bootstrap_training(n, bs_trainer, num_bootstrap_samples=5,
+                                 population_portion=0.8)
+    rep = reports[0.1]
+    assert rep.num_models == 5
+    cis = rep.coefficient_intervals
+    assert len(cis) == d
+    for j in range(d):
+        assert cis[j].min <= true_w[j] + 0.2
+        assert cis[j].max >= true_w[j] - 0.2
+        assert cis[j].count == 5
+    assert "MSE" in rep.metric_intervals
+    assert rep.metric_intervals["MSE"].mean < 1.0
+
+
+def test_bootstrap_validates_args():
+    with pytest.raises(ValueError):
+        bootstrap_training(10, lambda *a: [], num_bootstrap_samples=1)
+    with pytest.raises(ValueError):
+        bootstrap_training(10, lambda *a: [], num_bootstrap_samples=2,
+                           population_portion=1.5)
+
+
+def test_coefficient_summary_welford():
+    from photon_ml_tpu.diagnostics import CoefficientSummary
+
+    s = CoefficientSummary()
+    values = [1.0, 2.0, 3.0, 4.0]
+    for v in values:
+        s.accumulate(v)
+    assert s.mean == pytest.approx(np.mean(values))
+    assert s.variance == pytest.approx(np.var(values, ddof=1))
+    assert (s.min, s.max) == (1.0, 4.0)
+
+
+def test_render_html_report_smoke():
+    report = DiagnosticReport(
+        system={"task": "LOGISTIC_REGRESSION", "numRows": 10},
+        models=[ModelDiagnosticReport(
+            model_description="LogisticRegressionModel", reg_weight=1.0,
+            metrics={"AUC": 0.9})])
+    page = render_html_report(report)
+    assert "LogisticRegressionModel" in page and "AUC" in page
+    assert DiagnosticMode("ALL").train_enabled
+    assert not DiagnosticMode("VALIDATE").train_enabled
+
+
+def test_glm_driver_diagnostic_mode(tmp_path, rng):
+    from tests.test_cli_drivers import _write_glm_avro
+    from photon_ml_tpu.cli.glm_driver import run
+
+    train, valid, out = (tmp_path / "t", tmp_path / "v", tmp_path / "o")
+    w_true = np.array([1.0, -1.0, 0.5, 0.0, 2.0])
+    _write_glm_avro(train, rng, n=400, w=w_true)
+    _write_glm_avro(valid, rng, n=150, w=w_true)
+    run(["--training-data-directory", str(train),
+         "--validating-data-directory", str(valid),
+         "--output-directory", str(out),
+         "--task", "LOGISTIC_REGRESSION",
+         "--regularization-weights", "1.0",
+         "--max-num-iterations", "30",
+         "--diagnostic-mode", "ALL",
+         "--num-bootstrap-samples", "2"])
+    doc = json.loads((out / "model-diagnostic.json").read_text())
+    assert doc["system"]["diagnosticMode"] == "ALL"
+    (model,) = doc["models"]
+    assert model["featureImportance"][0]["rankedFeatures"]
+    assert "hosmerLemeshow" in model
+    assert "predictionErrorIndependence" in model
+    assert "fitting" in model and "bootstrap" in model
+    assert (out / "model-diagnostic.html").exists()
+    summary = json.loads((out / "summary.json").read_text())
+    assert "DIAGNOSED" in summary["stages"]
