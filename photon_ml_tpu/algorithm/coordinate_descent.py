@@ -67,7 +67,21 @@ class CoordinateDescent:
         num_iterations: int,
         seed: int = 0,
         initial_model: Optional[GameModel] = None,
+        checkpoint_dir=None,
+        checkpoint_interval: int = 1,
+        checkpoint_tag: str = "",
     ) -> CoordinateDescentResult:
+        """checkpoint_dir: save resumable state every `checkpoint_interval`
+        coordinate updates, and resume from the latest checkpoint found
+        there (the reference has no mid-training checkpointing — SURVEY §5;
+        per-step keys use fold_in so a resumed run is bit-identical to an
+        uninterrupted one). checkpoint_tag: caller-supplied configuration
+        fingerprint folded into the checkpoint identity check."""
+        from photon_ml_tpu.utils import checkpoint as ckpt
+
+        if checkpoint_interval < 1:
+            raise ValueError(
+                f"checkpoint_interval must be >= 1, got {checkpoint_interval}")
         loss = loss_for_task(self.task_type)
         names = list(self.coordinates)
 
@@ -77,22 +91,65 @@ class CoordinateDescent:
         else:
             models = {n: initial_model.get_model(n) for n in names}
 
-        scores: Dict[str, Array] = {
-            n: self.coordinates[n].score(models[n]) for n in names}
-        total = jnp.sum(jnp.stack(list(scores.values())), axis=0)
-
-        key = jax.random.PRNGKey(seed)
+        base_key = jax.random.PRNGKey(seed)
         objective_history: List[float] = []
         validation_history: List[Dict[str, float]] = []
         trackers: Dict[str, list] = {n: [] for n in names}
         timings: Dict[str, float] = {n: 0.0 for n in names}
         best_model, best_metric = None, None
+        done_steps = 0
+        meta = {"seed": seed, "coordinates": names,
+                "taskType": self.task_type.value, "tag": checkpoint_tag}
 
+        def _save(step):
+            ckpt.save_checkpoint(checkpoint_dir, ckpt.CheckpointState(
+                step=step, models=models,
+                objective_history=objective_history,
+                validation_history=validation_history,
+                best_metric=best_metric,
+                best_models=(dict(best_model.models)
+                             if best_model is not None else None),
+                timings=timings, trackers=trackers, meta=meta))
+
+        if checkpoint_dir is not None:
+            latest = ckpt.latest_checkpoint(checkpoint_dir)
+            if latest is not None:
+                state = ckpt.load_checkpoint(latest)
+                if state.meta is not None and state.meta != meta:
+                    raise ValueError(
+                        f"checkpoint {latest} belongs to a different "
+                        f"configuration (saved {state.meta}, current {meta});"
+                        " point --checkpoint-dir elsewhere or delete it")
+                done_steps = state.step
+                models = dict(state.models)
+                objective_history = list(state.objective_history)
+                validation_history = list(state.validation_history)
+                best_metric = state.best_metric
+                timings = dict(state.timings)
+                trackers = {n: list(state.trackers.get(n, []))
+                            for n in names}
+                if state.best_models is not None:
+                    best_model = GameModel(dict(state.best_models),
+                                           self.task_type)
+                logger.info("resumed from %s (step %d)", latest, done_steps)
+
+        scores: Dict[str, Array] = {
+            n: self.coordinates[n].score(models[n]) for n in names}
+        total = jnp.sum(jnp.stack(list(scores.values())), axis=0)
+
+        validating = (self.validation_data is not None
+                      and bool(self.validation_evaluators))
+        step = 0
         for it in range(num_iterations):
-            for n in names:
+            for ci, n in enumerate(names):
+                step += 1
+                if step <= done_steps:
+                    continue  # resumed past this update
                 coord = self.coordinates[n]
                 t0 = time.perf_counter()
-                key, sub = jax.random.split(key)
+                # Deterministic per-step key: resume-invariant, unlike
+                # sequential splitting.
+                sub = jax.random.fold_in(base_key, step)
                 # Single coordinate: residual is None (no other scores) —
                 # mirrors CoordinateDescent.scala's descend-only-one branch.
                 residual = None if len(names) == 1 else total - scores[n]
@@ -108,8 +165,19 @@ class CoordinateDescent:
                 objective_history.append(obj)
                 logger.info("iter %d coordinate %s: objective=%.6f", it, n,
                             obj)
+                # Defer the last-coordinate save to after validation: one
+                # save per iteration boundary, and a crash during validation
+                # resumes from before the final update, so the re-run never
+                # skips the iteration's validation/best-model bookkeeping.
+                last_of_iteration = ci == len(names) - 1
+                if (checkpoint_dir is not None
+                        and step % checkpoint_interval == 0
+                        and not (last_of_iteration and validating)):
+                    _save(step)
 
-            if self.validation_data is not None and self.validation_evaluators:
+            if step <= done_steps:
+                continue  # whole iteration was restored, incl. validation
+            if validating:
                 game_model = GameModel(dict(models), self.task_type)
                 val_scores = game_model.score(self.validation_data)
                 metrics = {
@@ -122,6 +190,10 @@ class CoordinateDescent:
                 if head.better_than(m0, best_metric):
                     best_metric, best_model = m0, game_model
                 logger.info("iter %d validation: %s", it, metrics)
+                if checkpoint_dir is not None:
+                    # The iteration-boundary save, carrying this iteration's
+                    # validation entry + best model.
+                    _save(step)
 
         final = GameModel(dict(models), self.task_type)
         if best_model is None:

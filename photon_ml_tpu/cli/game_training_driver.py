@@ -48,6 +48,13 @@ def _parse_named(values, what):
     return out
 
 
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="photon-game-training-driver",
@@ -73,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--updating-sequence", required=True,
                    help="comma-separated coordinate order")
     p.add_argument("--num-iterations", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="resumable coordinate-descent checkpoints land "
+                        "here; a rerun resumes from the latest")
+    p.add_argument("--checkpoint-interval", type=_positive_int, default=1,
+                   help="coordinate updates between checkpoints (>=1)")
     p.add_argument("--evaluators", default=None,
                    help="comma-separated evaluator specs (first selects)")
     p.add_argument("--id-types", default=None,
@@ -195,7 +207,11 @@ def run(argv=None) -> dict:
         task_type=task, coordinate_specs=specs,
         num_iterations=args.num_iterations,
         validation_evaluators=evaluators)
-    results = estimator.fit(data, validation_data=validation)
+    results = estimator.fit(
+        data, validation_data=validation,
+        checkpoint_dir=(Path(args.checkpoint_dir)
+                        if args.checkpoint_dir else None),
+        checkpoint_interval=args.checkpoint_interval)
     best_configs, best_result = estimator.select_best(results)
 
     save_game_model(

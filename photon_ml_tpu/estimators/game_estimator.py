@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
@@ -95,9 +96,16 @@ class GameEstimator:
         data: GameDataset,
         validation_data: Optional[GameDataset] = None,
         seed: int = 0,
+        checkpoint_dir=None,
+        checkpoint_interval: int = 1,
     ) -> List[Tuple[Dict[str, GLMOptimizationConfiguration],
                     CoordinateDescentResult]]:
-        """Train one model per per-coordinate config combination."""
+        """Train one model per per-coordinate config combination.
+
+        checkpoint_dir: per-combo subdirectories (combo-<i>/) receive
+        resumable coordinate-descent checkpoints every
+        checkpoint_interval updates; re-running fit with the same grid
+        resumes each combo from its latest checkpoint."""
         def _re_dataset(s):
             cfg = s.data_config
             if isinstance(s, FactoredRandomEffectSpec):
@@ -120,7 +128,7 @@ class GameEstimator:
         combos = itertools.product(
             *[[(s.name, c) for c in s.configs] for s in self.specs])
         results = []
-        for combo in combos:
+        for combo_index, combo in enumerate(combos):
             configs = dict(combo)
             coords = {}
             for s in self.specs:
@@ -150,7 +158,18 @@ class GameEstimator:
                 validation_evaluators=self.validation_evaluators)
             logger.info("training combo %s",
                         {k: v.to_string() for k, v in configs.items()})
-            results.append((configs, cd.run(self.num_iterations, seed=seed)))
+            combo_ckpt = (None if checkpoint_dir is None else
+                          Path(checkpoint_dir) / f"combo-{combo_index}")
+            # Fingerprint the combo's configs: grid changes re-enumerate
+            # combo indices, so without this a resume could silently load a
+            # different configuration's state.
+            tag = ";".join(f"{k}={v.to_string()}"
+                           for k, v in sorted(configs.items()))
+            results.append((configs, cd.run(
+                self.num_iterations, seed=seed,
+                checkpoint_dir=combo_ckpt,
+                checkpoint_interval=checkpoint_interval,
+                checkpoint_tag=tag)))
         return results
 
     def select_best(

@@ -1,0 +1,110 @@
+"""Mid-training checkpoint/resume for GAME coordinate descent.
+
+The reference has NO mid-training checkpointing (SURVEY.md §5: persistence
+is final model save + warm-start only) — this is a deliberate improvement.
+State = (coordinate models, linear step counter, histories, best model)
+saved every k coordinate updates; a killed run resumes from the last
+complete step and reproduces the uninterrupted run bit-for-bit because
+per-step PRNG keys are derived by `jax.random.fold_in(base, step)` rather
+than sequential splitting.
+
+Format: one pickle per step under <dir>/ckpt-<step>.pkl, written atomically
+(tmp + rename) so a crash mid-write never corrupts the latest checkpoint;
+device arrays are moved to host numpy first so files are
+backend-independent (a TPU run can resume on CPU and vice versa).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+_CKPT_RE = re.compile(r"ckpt-(\d+)\.pkl$")
+
+
+@dataclasses.dataclass
+class CheckpointState:
+    """Everything needed to resume CoordinateDescent.run mid-descent."""
+
+    step: int  # number of completed coordinate updates
+    models: Dict[str, Any]  # coordinate name -> sub-model (host arrays)
+    objective_history: List[float]
+    validation_history: List[Dict[str, float]]
+    best_metric: Optional[float]
+    best_models: Optional[Dict[str, Any]]  # host copy of best GameModel parts
+    timings: Dict[str, float]
+    # Per-coordinate optimizer trackers accumulated so far, so a resumed
+    # result's trackers stay aligned with objective_history.
+    trackers: Dict[str, list] = dataclasses.field(default_factory=dict)
+    # Identity fingerprint (seed, coordinate names, config tag). Loading
+    # into a run whose fingerprint differs is an error — without this a
+    # resume could silently continue from a different configuration's state.
+    meta: Optional[Dict[str, Any]] = None
+
+
+def to_host(obj):
+    """Recursively replace jax.Array leaves with numpy arrays in
+    dataclasses / dicts / lists / tuples. Arrays come back as numpy; jnp
+    consumers re-device them lazily on first use."""
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, np.ndarray) or obj is None or isinstance(
+            obj, (str, bytes, int, float, bool)):
+        return obj
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        changes = {f.name: to_host(getattr(obj, f.name))
+                   for f in dataclasses.fields(obj)}
+        return dataclasses.replace(obj, **changes)
+    if isinstance(obj, dict):
+        return {k: to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(to_host(v) for v in obj)
+    return obj
+
+
+def checkpoint_path(directory, step: int) -> Path:
+    return Path(directory) / f"ckpt-{step:08d}.pkl"
+
+
+def save_checkpoint(directory, state: CheckpointState,
+                    keep: int = 2) -> Path:
+    """Atomic write + retention of the newest `keep` checkpoints."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    path = checkpoint_path(directory, state.step)
+    tmp = path.with_suffix(".tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(to_host(state), f, protocol=pickle.HIGHEST_PROTOCOL)
+    tmp.rename(path)
+
+    steps = sorted(all_checkpoint_steps(directory))
+    for old in steps[:-keep]:
+        checkpoint_path(directory, old).unlink(missing_ok=True)
+    return path
+
+
+def all_checkpoint_steps(directory) -> List[int]:
+    directory = Path(directory)
+    if not directory.is_dir():
+        return []
+    return [int(m.group(1)) for p in directory.iterdir()
+            if (m := _CKPT_RE.search(p.name))]
+
+
+def latest_checkpoint(directory) -> Optional[Path]:
+    steps = all_checkpoint_steps(directory)
+    return checkpoint_path(directory, max(steps)) if steps else None
+
+
+def load_checkpoint(path) -> CheckpointState:
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if not isinstance(state, CheckpointState):
+        raise ValueError(f"{path} is not a CoordinateDescent checkpoint")
+    return state
