@@ -23,6 +23,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["true", "false"])
     p.add_argument("--output-dir", required=True)
     p.add_argument("--shard-name", default="global")
+    p.add_argument("--save-name-and-term-sets", default="false",
+                   choices=["true", "false"],
+                   help="also persist per-section (name, term) text sets "
+                        "(ml/avro/data/NameAndTermFeatureSetContainer.scala)")
     return p
 
 
@@ -36,6 +40,19 @@ def run(argv=None) -> Path:
     out = out_dir / f"{args.shard_name}.json"
     imap.save(out)
     logger.info("indexed %d features -> %s", len(imap), out)
+    if args.save_name_and_term_sets == "true":
+        from photon_ml_tpu.data.index_map import INTERCEPT_KEY, split_key
+        from photon_ml_tpu.data.name_and_term import (
+            NameAndTermFeatureSetContainer,
+        )
+
+        # The index map already holds every (name, term) — no second scan.
+        container = NameAndTermFeatureSetContainer({"features": {
+            split_key(k) for k, _ in imap.key_items()
+            if k != INTERCEPT_KEY}})
+        set_dir = out_dir / "name-and-term-sets"
+        container.save_as_text_files(set_dir)
+        logger.info("feature sets -> %s", set_dir)
     return out
 
 

@@ -18,12 +18,17 @@ from photon_ml_tpu.evaluation import build_evaluator
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import write_container
 from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.utils.date_range import resolve_input_dirs
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="photon-game-scoring-driver")
     p.add_argument("--input-dirs", required=True)
+    p.add_argument("--date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd; expands daily/yyyy/MM/dd "
+                        "subdirs of the input dirs")
+    p.add_argument("--date-range-days-ago", default=None)
     p.add_argument("--game-model-input-dir", required=True)
     p.add_argument("--output-dir", required=True)
     p.add_argument("--feature-index-dir", default=None,
@@ -59,7 +64,10 @@ def run(argv=None) -> dict:
          for k in ("rowEffectType", "colEffectType")} |
         {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
 
-    data, _ = read_game_dataset(args.input_dirs, id_types=id_types,
+    inputs = resolve_input_dirs(
+        args.input_dirs, date_range=args.date_range,
+        date_range_days_ago=args.date_range_days_ago)
+    data, _ = read_game_dataset(inputs, id_types=id_types,
                                 feature_shard_maps=shard_maps)
     scores = model.score(data)
     logger.info("scored %d rows", data.num_rows)

@@ -35,6 +35,7 @@ from photon_ml_tpu.optimization.config import (
     GLMOptimizationConfiguration,
 )
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.date_range import resolve_input_dirs
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
 
 
@@ -79,6 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="name:reOpt;latentOpt;mfMaxIter,numFactors[|...]")
     p.add_argument("--updating-sequence", required=True,
                    help="comma-separated coordinate order")
+    p.add_argument("--train-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd; expands daily/yyyy/MM/dd "
+                        "subdirs of the train input dirs")
+    p.add_argument("--train-date-range-days-ago", default=None,
+                   help="start-end in days ago, e.g. 90-1")
+    p.add_argument("--validate-date-range", default=None)
+    p.add_argument("--validate-date-range-days-ago", default=None)
     p.add_argument("--num-iterations", type=int, default=1)
     p.add_argument("--checkpoint-dir", default=None,
                    help="resumable coordinate-descent checkpoints land "
@@ -136,13 +144,20 @@ def run(argv=None) -> dict:
         {c.random_effect_type for c in fre_data.values()} |
         {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
 
-    logger.info("reading training data from %s", args.train_input_dirs)
-    data, shard_maps = read_game_dataset(args.train_input_dirs,
-                                         id_types=id_types)
+    train_inputs = resolve_input_dirs(
+        args.train_input_dirs,
+        date_range=args.train_date_range,
+        date_range_days_ago=args.train_date_range_days_ago)
+    logger.info("reading training data from %s", train_inputs)
+    data, shard_maps = read_game_dataset(train_inputs, id_types=id_types)
     validation = None
     if args.validate_input_dirs:
+        validate_inputs = resolve_input_dirs(
+            args.validate_input_dirs,
+            date_range=args.validate_date_range,
+            date_range_days_ago=args.validate_date_range_days_ago)
         validation, _ = read_game_dataset(
-            args.validate_input_dirs, id_types=id_types,
+            validate_inputs, id_types=id_types,
             feature_shard_maps=shard_maps)
 
     def parse_grid(s: str):
@@ -238,6 +253,7 @@ def run(argv=None) -> dict:
 
     summary = {
         "taskType": task.value,
+        "numRows": int(data.num_rows),
         "updatingSequence": sequence,
         "numCombos": len(results),
         "bestConfigs": {k: v.to_string() for k, v in best_configs.items()},

@@ -24,6 +24,13 @@ from photon_ml_tpu.io.avro_codec import read_container
 
 
 def _avro_paths(path) -> List[Path]:
+    """path: one file/dir, or a list of them (date-range resolution hands
+    the readers a list of daily directories)."""
+    if isinstance(path, (list, tuple)):
+        out: List[Path] = []
+        for p in path:
+            out.extend(_avro_paths(p))
+        return out
     p = Path(path)
     if p.is_dir():
         files = sorted(q for q in p.iterdir() if q.suffix == ".avro")

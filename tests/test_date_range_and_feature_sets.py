@@ -1,0 +1,131 @@
+"""DateRange resolution (ml/util/DateRange.scala, IOUtils daily-dir
+expansion) and NameAndTermFeatureSetContainer parity tests."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.index_map import feature_key
+from photon_ml_tpu.data.name_and_term import NameAndTermFeatureSetContainer
+from photon_ml_tpu.utils.date_range import (
+    DateRange,
+    resolve_input_dirs,
+    resolve_paths_within_date_range,
+)
+
+
+def test_date_range_parse_and_str():
+    r = DateRange.from_string("20260101-20260115")
+    assert r.start == datetime.date(2026, 1, 1)
+    assert r.end == datetime.date(2026, 1, 15)
+    assert str(r) == "2026-01-01-2026-01-15"
+    assert len(r.days()) == 15
+
+
+def test_date_range_validation():
+    with pytest.raises(ValueError, match="comes after"):
+        DateRange.from_string("20260115-20260101")
+    with pytest.raises(ValueError, match="parse"):
+        DateRange.from_string("garbage")
+    with pytest.raises(ValueError, match="parse"):
+        DateRange.from_string("20260101-20260115-2026")
+
+
+def test_date_range_days_ago():
+    today = datetime.date(2026, 7, 29)
+    r = DateRange.from_days_ago(7, 1, today=today)
+    assert r.start == datetime.date(2026, 7, 22)
+    assert r.end == datetime.date(2026, 7, 28)
+    r2 = DateRange.from_days_ago_string("7-1", today=today)
+    assert r2 == r
+    with pytest.raises(ValueError, match="negative"):
+        DateRange.from_days_ago(-1, 0)
+
+
+def test_resolve_daily_paths(tmp_path):
+    for day in ("2026/01/01", "2026/01/02", "2026/01/04"):
+        (tmp_path / "daily" / day).mkdir(parents=True)
+    rng = DateRange.from_string("20260101-20260105")
+    paths = resolve_paths_within_date_range([tmp_path], rng)
+    assert [p.name for p in paths] == ["01", "02", "04"]
+    with pytest.raises(FileNotFoundError, match="Missing"):
+        resolve_paths_within_date_range([tmp_path], rng,
+                                        error_on_missing=True)
+    with pytest.raises(FileNotFoundError, match="No data folder"):
+        resolve_paths_within_date_range(
+            [tmp_path], DateRange.from_string("20270101-20270102"))
+
+
+def test_resolve_input_dirs_passthrough_and_exclusive(tmp_path):
+    assert resolve_input_dirs([tmp_path]) == [tmp_path]
+    with pytest.raises(ValueError, match="at most one"):
+        resolve_input_dirs([tmp_path], date_range="20260101-20260102",
+                           date_range_days_ago="7-1")
+
+
+def test_name_and_term_container_roundtrip(tmp_path):
+    container = NameAndTermFeatureSetContainer({
+        "features": {("age", ""), ("height", "cm")},
+        "songFeatures": {("tempo", "bpm")},
+    })
+    imap = container.get_feature_name_and_term_to_index_map(
+        ["features", "songFeatures"], add_intercept=True)
+    assert len(imap) == 4
+    assert imap.get_index(feature_key("tempo", "bpm")) >= 0
+    assert imap.intercept_index == 3  # appended last
+
+    container.save_as_text_files(tmp_path)
+    loaded = NameAndTermFeatureSetContainer.load_from_text_files(
+        tmp_path, ["features", "songFeatures"])
+    assert loaded.feature_sets == container.feature_sets
+
+
+def test_name_and_term_from_avro(tmp_path, rng):
+    from tests.test_cli_drivers import _write_glm_avro
+
+    _write_glm_avro(tmp_path / "data", rng, n=30, d=4)
+    container = NameAndTermFeatureSetContainer.from_avro(tmp_path / "data")
+    assert len(container.feature_sets["features"]) == 4
+    imap = container.get_feature_name_and_term_to_index_map(["features"])
+    assert len(imap) == 4
+
+
+def test_game_driver_with_date_partitioned_input(tmp_path, rng):
+    from tests.test_cli_drivers import _write_game_avro
+    from photon_ml_tpu.cli import game_training_driver
+
+    for day in ("2026/07/01", "2026/07/02"):
+        _write_game_avro(tmp_path / "train" / "daily" / day, rng, n=120)
+    _write_game_avro(tmp_path / "valid", rng, n=80)
+    out = tmp_path / "out"
+    summary = game_training_driver.run([
+        "--train-input-dirs", str(tmp_path / "train"),
+        "--train-date-range", "20260701-20260702",
+        "--validate-input-dirs", str(tmp_path / "valid"),
+        "--output-dir", str(out),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:20,1e-7,1.0,1.0,LBFGS,L2",
+        "--updating-sequence", "fixed",
+        "--num-iterations", "1",
+        "--evaluators", "AUC",
+    ])
+    # Both daily partitions were ingested.
+    assert summary["numRows"] == 240
+
+
+def test_feature_indexing_saves_name_and_term_sets(tmp_path, rng):
+    from tests.test_cli_drivers import _write_glm_avro
+    from photon_ml_tpu.cli import feature_indexing
+
+    _write_glm_avro(tmp_path / "data", rng, n=20, d=3)
+    feature_indexing.run([
+        "--data-path", str(tmp_path / "data"),
+        "--output-dir", str(tmp_path / "out"),
+        "--save-name-and-term-sets", "true",
+    ])
+    sets_file = tmp_path / "out" / "name-and-term-sets" / "features.txt"
+    assert sets_file.exists()
+    assert len(sets_file.read_text().splitlines()) == 3
