@@ -39,6 +39,7 @@ from photon_ml_tpu.diagnostics import (
 from photon_ml_tpu.diagnostics.reporting import ModelDiagnosticReport
 from photon_ml_tpu.estimators.model_selection import select_best_model
 from photon_ml_tpu.estimators.model_training import train_glm_models
+from photon_ml_tpu.evaluation.evaluators import METRIC_METADATA
 from photon_ml_tpu.evaluation.validation import evaluate_glm
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import write_container
@@ -331,9 +332,17 @@ def run(argv=None) -> dict:
                 metrics_by_lambda[t.reg_weight] = evaluate_glm(
                     task, scored[t.reg_weight], vy, voff, vw,
                     num_coefficients=mat.shape[1])
+            metric_names = sorted(
+                {m for ms in metrics_by_lambda.values() for m in ms})
             (out_dir / "validation-metrics.json").write_text(
-                json.dumps({str(k): v for k, v in metrics_by_lambda.items()},
-                           indent=2))
+                json.dumps({
+                    "metrics": {str(k): v
+                                for k, v in metrics_by_lambda.items()},
+                    "metricMetadata": {
+                        name: METRIC_METADATA[name].to_dict()
+                        for name in metric_names
+                        if name in METRIC_METADATA},
+                }, indent=2))
         stages.append("VALIDATED")
 
     # ---- diagnose --------------------------------------------------------

@@ -30,37 +30,13 @@ _NUM_SPLITS = 1000
 _MAX_TRAIN_SPLITS = 900
 
 
-@dataclasses.dataclass
-class CoefficientSummary:
-    """Streaming min/max/mean/variance summary of one scalar across
-    bootstrap models (reference: ml/supervised/model/CoefficientSummary.scala)."""
-
-    count: int = 0
-    mean: float = 0.0
-    m2: float = 0.0
-    min: float = float("inf")
-    max: float = float("-inf")
-
-    def accumulate(self, x: float) -> None:
-        x = float(x)
-        self.count += 1
-        delta = x - self.mean
-        self.mean += delta / self.count
-        self.m2 += delta * (x - self.mean)
-        self.min = min(self.min, x)
-        self.max = max(self.max, x)
-
-    @property
-    def variance(self) -> float:
-        return self.m2 / (self.count - 1) if self.count > 1 else 0.0
-
-    @property
-    def std_dev(self) -> float:
-        return float(np.sqrt(self.variance))
-
-    def to_dict(self) -> Dict[str, float]:
-        return {"count": self.count, "mean": self.mean, "min": self.min,
-                "max": self.max, "stdDev": self.std_dev}
+# The canonical CoefficientSummary lives with the model-tracking surface
+# (ml/supervised/model/CoefficientSummary.scala); re-exported here for the
+# bootstrap CI aggregates.
+from photon_ml_tpu.models.tracking import (  # noqa: E402
+    CoefficientSummary,
+    summarize_coefficients,
+)
 
 
 def aggregate_coefficient_confidence_intervals(
@@ -68,14 +44,7 @@ def aggregate_coefficient_confidence_intervals(
 ) -> List[CoefficientSummary]:
     """Per-coefficient summaries across bootstrap models, 1:1 with the
     coefficient vector (ml/BootstrapTraining.scala:46-70)."""
-    summaries: List[CoefficientSummary] = []
-    for model, _ in models_and_metrics:
-        means = np.asarray(model.coefficients.means)
-        if not summaries:
-            summaries = [CoefficientSummary() for _ in range(len(means))]
-        for s, value in zip(summaries, means):
-            s.accumulate(value)
-    return summaries
+    return summarize_coefficients([m for m, _ in models_and_metrics])
 
 
 def aggregate_metrics_confidence_intervals(

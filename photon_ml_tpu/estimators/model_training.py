@@ -19,6 +19,7 @@ import scipy.sparse as sp
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel, model_for_task
+from photon_ml_tpu.models.tracking import ModelTracker
 from photon_ml_tpu.ops.features import (
     DENSE_DENSITY_THRESHOLD,
     features_to_device,
@@ -43,6 +44,9 @@ class TrainedGLM:
     reg_weight: float
     model: GeneralizedLinearModel
     result: OptimizerResult
+    # Populated when training ran with track_models=True
+    # (reference: ml/supervised/model/ModelTracker.scala).
+    tracker: Optional["ModelTracker"] = None
 
 
 def device_batch(features, labels, offsets=None, weights=None,
@@ -77,6 +81,7 @@ def train_glm_models(
     compute_variances: bool = False,
     dtype=jnp.float64,
     initial_model: Optional[GeneralizedLinearModel] = None,
+    track_models: bool = False,
 ) -> List[TrainedGLM]:
     """Train one GLM per λ, descending, warm-started. Returns grid order
     as given (the reference reports models keyed by λ)."""
@@ -102,7 +107,8 @@ def train_glm_models(
             regularization_weight=lam,
             optimizer_type=optimizer_type,
             regularization_context=regularization_context)
-        result = solve_glm(objective, batch, config, coef, lb, ub)
+        result = solve_glm(objective, batch, config, coef, lb, ub,
+                           track_coefficients=track_models)
         if warm_start:
             coef = result.x
         variances = None
@@ -113,7 +119,9 @@ def train_glm_models(
         if normalization is not None:
             out_coef = normalization.model_to_original_space(out_coef)
         model = glm_cls(Coefficients(out_coef, variances))
-        by_weight[lam] = TrainedGLM(lam, model, result)
+        tracker = (ModelTracker.from_result(result, task, normalization)
+                   if track_models else None)
+        by_weight[lam] = TrainedGLM(lam, model, result, tracker)
         logger.info(
             "lambda=%g: value=%.6f iters=%d reason=%s", lam,
             float(result.value), int(result.iterations),

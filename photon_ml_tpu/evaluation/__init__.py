@@ -2,6 +2,9 @@
 
 from photon_ml_tpu.evaluation.evaluators import (
     Evaluator,
+    METRIC_METADATA,
+    MetricMetadata,
+    metadata_for,
     AreaUnderROCCurveEvaluator,
     RMSEEvaluator,
     LogisticLossEvaluator,
@@ -15,6 +18,9 @@ from photon_ml_tpu.evaluation.evaluators import (
 
 __all__ = [
     "Evaluator",
+    "METRIC_METADATA",
+    "MetricMetadata",
+    "metadata_for",
     "AreaUnderROCCurveEvaluator",
     "RMSEEvaluator",
     "LogisticLossEvaluator",

@@ -24,6 +24,72 @@ def _as_np(a):
 
 
 @dataclasses.dataclass(frozen=True)
+class MetricMetadata:
+    """Metadata about a metric (reference: ml/metric/MetricMetadata.scala).
+
+    ``higher_is_better`` plays the role of the reference's
+    worstToBestOrdering; ``value_range`` its rangeOption.
+    """
+
+    name: str
+    description: str
+    higher_is_better: bool
+    value_range: Optional[tuple] = None  # (min, max)
+
+    def to_dict(self) -> dict:
+        return {"description": self.description,
+                "higherIsBetter": self.higher_is_better,
+                "range": self.value_range}
+
+
+# Registry covering every metric emitted by evaluate_glm and the evaluator
+# family. Drivers attach these to their metric reports (the reference binds
+# MetricMetadata to each logged metric in ml/Evaluation.scala).
+METRIC_METADATA = {
+    m.name: m for m in [
+        MetricMetadata("AUC", "area under the ROC curve", True, (0.0, 1.0)),
+        MetricMetadata("ACCURACY", "weighted classification accuracy", True,
+                       (0.0, 1.0)),
+        MetricMetadata("PRECISION", "precision at the response threshold",
+                       True, (0.0, 1.0)),
+        MetricMetadata("RECALL", "recall at the response threshold", True,
+                       (0.0, 1.0)),
+        MetricMetadata("F1", "harmonic mean of precision and recall", True,
+                       (0.0, 1.0)),
+        MetricMetadata("LOG_LIKELIHOOD", "data log-likelihood", True),
+        MetricMetadata("AIC", "Akaike information criterion", False),
+        MetricMetadata("RMSE", "root mean squared error", False),
+        MetricMetadata("MSE", "mean squared error", False),
+        MetricMetadata("MAE", "mean absolute error", False),
+        MetricMetadata("R2", "coefficient of determination", True),
+        MetricMetadata("POISSON_LOSS", "Poisson negative log-likelihood",
+                       False),
+        MetricMetadata("LOGISTIC_LOSS", "logistic loss", False),
+        MetricMetadata("SQUARED_LOSS", "squared loss", False),
+        MetricMetadata("SMOOTHED_HINGE_LOSS", "Rennie smoothed hinge loss",
+                       False),
+    ]
+}
+
+
+def metadata_for(evaluator: "Evaluator") -> MetricMetadata:
+    """MetricMetadata for an evaluator (sharded evaluators inherit the base
+    metric's metadata; PRECISION@k is synthesized)."""
+    base = evaluator.name.split(":")[0].upper()
+    if base in METRIC_METADATA:
+        meta = METRIC_METADATA[base]
+        return dataclasses.replace(meta, name=evaluator.name)
+    if base.startswith("PRECISION@"):
+        return MetricMetadata(evaluator.name, "precision in the top k",
+                              True, (0.0, 1.0))
+    return MetricMetadata(
+        name=evaluator.name,
+        description=evaluator.name,
+        higher_is_better=evaluator.higher_is_better,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class Evaluator:
     name: str
 

@@ -14,6 +14,12 @@ from photon_ml_tpu.models.random_effect import RandomEffectModel
 from photon_ml_tpu.models.factored_random_effect import FactoredRandomEffectModel
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.models.game_model import GameModel
+from photon_ml_tpu.models.tracking import (
+    CoefficientSummary,
+    ModelTracker,
+    OptimizerState,
+    summarize_coefficients,
+)
 
 __all__ = [
     "Coefficients",
@@ -28,4 +34,8 @@ __all__ = [
     "FactoredRandomEffectModel",
     "MatrixFactorizationModel",
     "GameModel",
+    "CoefficientSummary",
+    "ModelTracker",
+    "OptimizerState",
+    "summarize_coefficients",
 ]

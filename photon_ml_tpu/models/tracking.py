@@ -1,0 +1,187 @@
+"""Per-iteration model tracking and coefficient summaries.
+
+TPU-native counterparts of the reference's training telemetry surface:
+
+- ``ModelTracker`` (ml/supervised/model/ModelTracker.scala) pairs the
+  optimizer's per-iteration states with the per-iteration models. Here the
+  states come straight out of ``OptimizerResult``'s fixed-shape history
+  arrays (recorded inside the ``lax.while_loop`` — no host round trip per
+  iteration) and the models are materialized lazily from
+  ``result.coef_history``.
+- ``CoefficientSummary`` (ml/supervised/model/CoefficientSummary.scala)
+  accumulates distributional statistics of a coefficient across models
+  (bootstrap replicates, per-entity random effects): min/quartiles/max,
+  mean, stddev, count. Quartiles use the reference's sorted-index estimator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, model_for_task
+from photon_ml_tpu.optimization.convergence import (
+    ConvergenceReason,
+    OptimizerResult,
+)
+from photon_ml_tpu.types import TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerState:
+    """One optimizer iteration: (iteration, objective value, gradient norm).
+
+    The reference's OptimizerState additionally carries the coefficient
+    vector (ml/optimization/OptimizerState.scala); here coefficients live in
+    ``ModelTracker.models`` to keep the state list cheap.
+    """
+
+    iteration: int
+    value: float
+    grad_norm: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelTracker:
+    """Optimization states + the model produced at each iteration.
+
+    Built from an ``OptimizerResult`` whose solve ran with
+    ``track_coefficients=True`` (models are empty otherwise — states alone
+    are always available).
+    """
+
+    states: List[OptimizerState]
+    models: List[GeneralizedLinearModel]
+    convergence_reason: ConvergenceReason
+
+    @classmethod
+    def from_result(
+        cls,
+        result: OptimizerResult,
+        task: TaskType,
+        normalization: Optional[NormalizationContext] = None,
+    ) -> "ModelTracker":
+        iters = int(result.iterations)
+        values = np.asarray(result.value_history)[: iters + 1]
+        gnorms = np.asarray(result.grad_norm_history)[: iters + 1]
+        states = [
+            OptimizerState(k, float(values[k]), float(gnorms[k]))
+            for k in range(iters + 1)
+        ]
+        models: List[GeneralizedLinearModel] = []
+        if result.coef_history is not None:
+            glm_cls = model_for_task(task)
+            coefs = np.asarray(result.coef_history)[: iters + 1]
+            for row in coefs:
+                w = row
+                if normalization is not None:
+                    w = np.asarray(
+                        normalization.model_to_original_space(row))
+                models.append(glm_cls(Coefficients(w)))
+        return cls(states, models, result.reason_enum())
+
+    @property
+    def num_iterations(self) -> int:
+        return len(self.states) - 1 if self.states else 0
+
+
+class CoefficientSummary:
+    """Streaming summary of one coefficient's distribution across models.
+
+    The single canonical implementation (also re-exported by
+    photon_ml_tpu.diagnostics for the bootstrap CI aggregates,
+    ml/BootstrapTraining.scala). Assumes a modest number of samples
+    (bootstrap replicates, λ-grid points) — quantiles keep all values, like
+    the reference.
+    """
+
+    def __init__(self) -> None:
+        self._values: List[float] = []
+
+    def accumulate(self, x: float) -> None:
+        self._values.append(float(x))
+
+    @classmethod
+    def of(cls, values: Sequence[float]) -> "CoefficientSummary":
+        s = cls()
+        for v in values:
+            s.accumulate(v)
+        return s
+
+    @property
+    def count(self) -> int:
+        return len(self._values)
+
+    @property
+    def mean(self) -> float:
+        return float(np.mean(self._values)) if self._values else float("nan")
+
+    @property
+    def min(self) -> float:
+        return float(np.min(self._values)) if self._values else float("nan")
+
+    @property
+    def max(self) -> float:
+        return float(np.max(self._values)) if self._values else float("nan")
+
+    @property
+    def variance(self) -> float:
+        # Sample variance (ddof=1), matching commons-math
+        # SummaryStatistics semantics.
+        if len(self._values) < 2:
+            return 0.0 if self._values else float("nan")
+        return float(np.var(self._values, ddof=1))
+
+    @property
+    def std_dev(self) -> float:
+        if len(self._values) < 2:
+            return 0.0 if self._values else float("nan")
+        return float(np.std(self._values, ddof=1))
+
+    def _quantile_index(self, q: int) -> float:
+        # Reference estimator: sorted[q * n / 4] (integer division).
+        if not self._values:
+            return float("nan")
+        s = sorted(self._values)
+        return s[min(q * len(s) // 4, len(s) - 1)]
+
+    def first_quartile(self) -> float:
+        return self._quantile_index(1)
+
+    def median(self) -> float:
+        return self._quantile_index(2)
+
+    def third_quartile(self) -> float:
+        return self._quantile_index(3)
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "mean": self.mean, "min": self.min,
+                "max": self.max, "stdDev": self.std_dev}
+
+    def __str__(self) -> str:
+        return (
+            f"Range: [Min: {self.min:.03f}, Q1: {self.first_quartile():.03f},"
+            f" Med: {self.median():.03f}, Q3: {self.third_quartile():.03f},"
+            f" Max: {self.max:.03f}) Mean: [{self.mean:.03f}],"
+            f" Std. Dev.[{self.std_dev:.03f}], # samples = [{self.count}]"
+        )
+
+
+def summarize_coefficients(
+    models: Sequence[GeneralizedLinearModel],
+) -> List[CoefficientSummary]:
+    """Per-coordinate CoefficientSummary across a collection of models
+    (the reference builds these from bootstrap replicates,
+    ml/BootstrapTraining.scala)."""
+    if not models:
+        return []
+    mats = np.stack(
+        [np.asarray(m.coefficients.means) for m in models])  # [k, d]
+    out = []
+    for j in range(mats.shape[1]):
+        out.append(CoefficientSummary.of(mats[:, j]))
+    return out

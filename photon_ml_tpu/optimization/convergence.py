@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+from typing import Optional
 
 import jax
 
@@ -59,6 +60,10 @@ class OptimizerResult:
     reason: Array  # i32, a ConvergenceReason value
     value_history: Array
     grad_norm_history: Array
+    # Per-iteration coefficient snapshots [max_iter+1, d], recorded only when
+    # the solver was asked to track them (the reference's ModelTracker state,
+    # ml/supervised/model/ModelTracker.scala). None otherwise.
+    coef_history: Optional[Array] = None
 
     @property
     def converged(self) -> Array:
@@ -70,7 +75,7 @@ class OptimizerResult:
     def tree_flatten(self):
         return (
             self.x, self.value, self.grad_norm, self.iterations, self.reason,
-            self.value_history, self.grad_norm_history,
+            self.value_history, self.grad_norm_history, self.coef_history,
         ), None
 
     @classmethod

@@ -127,6 +127,7 @@ class _LoopState(NamedTuple):
     reason: Array
     value_hist: Array
     gnorm_hist: Array
+    coef_hist: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
 def _project(x: Array, lower: Optional[Array], upper: Optional[Array]) -> Array:
@@ -192,12 +193,12 @@ def backtracking_line_search(
     jax.jit,
     static_argnames=(
         "fun", "max_iter", "tol", "history_size", "c1", "max_line_search",
-        "has_bounds",
+        "has_bounds", "track_coefficients",
     ),
 )
 def _minimize_lbfgs_impl(
     fun, x0, args, lower, upper, *, max_iter, tol, history_size, c1,
-    max_line_search, has_bounds,
+    max_line_search, has_bounds, track_coefficients=False,
 ) -> OptimizerResult:
     vg = jax.value_and_grad(fun)
     dtype = x0.dtype
@@ -212,12 +213,14 @@ def _minimize_lbfgs_impl(
 
     value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
     gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+    coef_hist = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+                 if track_coefficients else None)
 
     init = _LoopState(
         x=x0, f=f0, g=g0, hist=_empty_history(d, history_size, dtype),
         it=jnp.zeros((), jnp.int32),
         reason=jnp.full((), int(ConvergenceReason.NOT_CONVERGED), jnp.int32),
-        value_hist=value_hist, gnorm_hist=gnorm_hist,
+        value_hist=value_hist, gnorm_hist=gnorm_hist, coef_hist=coef_hist,
     )
 
     def cond(st: _LoopState):
@@ -279,6 +282,8 @@ def _minimize_lbfgs_impl(
             reason=reason,
             value_hist=st.value_hist.at[it_new].set(f_new),
             gnorm_hist=st.gnorm_hist.at[it_new].set(gnorm_new),
+            coef_hist=(None if st.coef_hist is None
+                       else st.coef_hist.at[it_new].set(x_new)),
         )
         # Freeze lanes that already finished (vmap safety).
         done = ~cond(st)
@@ -297,6 +302,7 @@ def _minimize_lbfgs_impl(
         x=final.x, value=final.f, grad_norm=jnp.linalg.norm(final.g),
         iterations=final.it, reason=final.reason,
         value_history=final.value_hist, grad_norm_history=final.gnorm_hist,
+        coef_history=final.coef_hist,
     )
 
 
@@ -312,6 +318,7 @@ def minimize_lbfgs(
     upper_bounds: Optional[Array] = None,
     c1: float = 1e-4,
     max_line_search: int = 30,
+    track_coefficients: bool = False,
 ) -> OptimizerResult:
     """Minimize ``fun(x, *args)`` from ``x0``.
 
@@ -320,6 +327,8 @@ def minimize_lbfgs(
 
     ``fun`` must be a pure jnp scalar function. For the distributed mode pass
     sharded ``args``; for batched per-entity solves wrap with ``jax.vmap``.
+    ``track_coefficients`` records per-iteration coefficient snapshots in
+    ``result.coef_history`` (costs an extra [max_iter+1, d] buffer).
     """
     dtype = jnp.asarray(x0).dtype
     has_bounds = lower_bounds is not None or upper_bounds is not None
@@ -332,4 +341,5 @@ def minimize_lbfgs(
         fun, jnp.asarray(x0), args, lo, hi,
         max_iter=max_iter, tol=tol, history_size=history_size, c1=c1,
         max_line_search=max_line_search, has_bounds=has_bounds,
+        track_coefficients=track_coefficients,
     )

@@ -19,7 +19,7 @@ re-runs without recompiling.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,15 +63,17 @@ class _State(NamedTuple):
     reason: Array
     value_hist: Array
     gnorm_hist: Array
+    coef_hist: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("fun", "max_iter", "tol", "history_size", "c1",
-                     "max_line_search"),
+                     "max_line_search", "track_coefficients"),
 )
 def _minimize_owlqn_impl(
-    fun, x0, l1, args, *, max_iter, tol, history_size, c1, max_line_search
+    fun, x0, l1, args, *, max_iter, tol, history_size, c1, max_line_search,
+    track_coefficients=False,
 ) -> OptimizerResult:
     vg = jax.value_and_grad(fun)
     dtype = x0.dtype
@@ -88,6 +90,8 @@ def _minimize_owlqn_impl(
 
     value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
     gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(pgnorm0)
+    coef_hist = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+                 if track_coefficients else None)
 
     init = _State(
         x=x0, f=f0, g=g0, pg=pg0,
@@ -98,7 +102,7 @@ def _minimize_owlqn_impl(
             int(ConvergenceReason.GRADIENT_CONVERGED),
             int(ConvergenceReason.NOT_CONVERGED),
         ).astype(jnp.int32),
-        value_hist=value_hist, gnorm_hist=gnorm_hist,
+        value_hist=value_hist, gnorm_hist=gnorm_hist, coef_hist=coef_hist,
     )
 
     def cond(st: _State):
@@ -161,6 +165,8 @@ def _minimize_owlqn_impl(
             reason=reason,
             value_hist=st.value_hist.at[it_new].set(f_new),
             gnorm_hist=st.gnorm_hist.at[it_new].set(pgnorm_new),
+            coef_hist=(None if st.coef_hist is None
+                       else st.coef_hist.at[it_new].set(x_new)),
         )
         done = ~cond(st)
         return jax.tree.map(lambda a, b: jnp.where(done, a, b), st, new)
@@ -170,6 +176,7 @@ def _minimize_owlqn_impl(
         x=final.x, value=final.f, grad_norm=jnp.linalg.norm(final.pg),
         iterations=final.it, reason=final.reason,
         value_history=final.value_hist, grad_norm_history=final.gnorm_hist,
+        coef_history=final.coef_hist,
     )
 
 
@@ -184,6 +191,7 @@ def minimize_owlqn(
     history_size: int = 10,
     c1: float = 1e-4,
     max_line_search: int = 30,
+    track_coefficients: bool = False,
 ) -> OptimizerResult:
     """Minimize fun(x, *args) + l1_weight . |x| from x0.
 
@@ -195,4 +203,5 @@ def minimize_owlqn(
     return _minimize_owlqn_impl(
         fun, x0, l1, args, max_iter=max_iter, tol=tol,
         history_size=history_size, c1=c1, max_line_search=max_line_search,
+        track_coefficients=track_coefficients,
     )

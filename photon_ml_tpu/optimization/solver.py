@@ -35,6 +35,7 @@ def solve_glm(
     coef0: Array,
     lower_bounds: Optional[Array] = None,
     upper_bounds: Optional[Array] = None,
+    track_coefficients: bool = False,
 ) -> OptimizerResult:
     """One GLM solve. Pure: jit/vmap-safe given consistent static config."""
     lam = config.regularization_weight
@@ -59,18 +60,19 @@ def solve_glm(
         return minimize_tron(
             fun, coef0, args=(batch, l2_arr), max_iter=config.max_iterations,
             tol=config.tolerance, lower_bounds=lower_bounds,
-            upper_bounds=upper_bounds)
+            upper_bounds=upper_bounds, track_coefficients=track_coefficients)
     if l1 > 0:
         if lower_bounds is not None or upper_bounds is not None:
             raise ValueError(
                 "box constraints with L1 regularization are not supported")
         return minimize_owlqn(
             fun, coef0, args=(batch, l2_arr), l1_weight=l1,
-            max_iter=config.max_iterations, tol=config.tolerance)
+            max_iter=config.max_iterations, tol=config.tolerance,
+            track_coefficients=track_coefficients)
     return minimize_lbfgs(
         fun, coef0, args=(batch, l2_arr), max_iter=config.max_iterations,
         tol=config.tolerance, lower_bounds=lower_bounds,
-        upper_bounds=upper_bounds)
+        upper_bounds=upper_bounds, track_coefficients=track_coefficients)
 
 
 def regularization_term(config: GLMOptimizationConfiguration, coefs):

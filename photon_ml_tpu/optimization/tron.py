@@ -110,16 +110,18 @@ class _TronState(NamedTuple):
     value_hist: Array
     gnorm_hist: Array
     first: Array  # bool: before first step (delta clamp rule)
+    coef_hist: Optional[Array]  # [max_iter+1, d] when tracking, else None
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("fun", "max_iter", "tol", "max_cg",
-                     "max_improvement_failures", "has_bounds"),
+                     "max_improvement_failures", "has_bounds",
+                     "track_coefficients"),
 )
 def _minimize_tron_impl(
     fun, x0, args, lower, upper, *, max_iter, tol, max_cg,
-    max_improvement_failures, has_bounds,
+    max_improvement_failures, has_bounds, track_coefficients=False,
 ) -> OptimizerResult:
     vg = jax.value_and_grad(fun)
     dtype = x0.dtype
@@ -140,6 +142,8 @@ def _minimize_tron_impl(
 
     value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
     gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+    coef_hist = (jnp.zeros((max_iter + 1, x0.shape[-1]), dtype).at[0].set(x0)
+                 if track_coefficients else None)
 
     init = _TronState(
         x=x0, f=f0, g=g0, delta=gnorm0,
@@ -148,7 +152,7 @@ def _minimize_tron_impl(
             gnorm0 <= 0.0, int(ConvergenceReason.GRADIENT_CONVERGED),
             int(ConvergenceReason.NOT_CONVERGED)).astype(jnp.int32),
         value_hist=value_hist, gnorm_hist=gnorm_hist,
-        first=jnp.ones((), bool),
+        first=jnp.ones((), bool), coef_hist=coef_hist,
     )
 
     def cond(st: _TronState):
@@ -250,6 +254,10 @@ def _minimize_tron_impl(
                 accept, st.gnorm_hist.at[it_new].set(gnorm_acc),
                 st.gnorm_hist),
             first=jnp.zeros((), bool),
+            coef_hist=(None if st.coef_hist is None
+                       else jnp.where(
+                           accept, st.coef_hist.at[it_new].set(x_acc),
+                           st.coef_hist)),
         )
         done = ~cond(st)
         return jax.tree.map(lambda a, b: jnp.where(done, a, b), st, new)
@@ -259,6 +267,7 @@ def _minimize_tron_impl(
         x=final.x, value=final.f, grad_norm=jnp.linalg.norm(final.g),
         iterations=final.it, reason=final.reason,
         value_history=final.value_hist, grad_norm_history=final.gnorm_hist,
+        coef_history=final.coef_hist,
     )
 
 
@@ -273,6 +282,7 @@ def minimize_tron(
     max_improvement_failures: int = 5,
     lower_bounds: Optional[Array] = None,
     upper_bounds: Optional[Array] = None,
+    track_coefficients: bool = False,
 ) -> OptimizerResult:
     """Minimize twice-differentiable ``fun(x, *args)`` from ``x0``.
 
@@ -290,5 +300,5 @@ def minimize_tron(
     return _minimize_tron_impl(
         fun, x0, args, lo, hi, max_iter=max_iter, tol=tol, max_cg=max_cg,
         max_improvement_failures=max_improvement_failures,
-        has_bounds=has_bounds,
+        has_bounds=has_bounds, track_coefficients=track_coefficients,
     )

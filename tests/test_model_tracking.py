@@ -1,0 +1,149 @@
+"""ModelTracker / CoefficientSummary tests.
+
+Reference pattern: ml/supervised/model/ModelTracker.scala pairs optimization
+states with per-iteration models; CoefficientSummary.scala accumulates
+coefficient distribution stats (unit-tested in
+photon-ml/src/test/scala/.../supervised/model/CoefficientSummaryTest.scala).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.estimators.model_training import train_glm_models
+from photon_ml_tpu.models import (
+    CoefficientSummary,
+    ModelTracker,
+    summarize_coefficients,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import LogisticRegressionModel
+from photon_ml_tpu.optimization import (
+    minimize_lbfgs,
+    minimize_owlqn,
+    minimize_tron,
+)
+from photon_ml_tpu.types import TaskType
+
+
+def _quad(x):
+    c = jnp.asarray([1.0, -2.0, 3.0])
+    return jnp.sum((x - c) ** 2)
+
+
+@pytest.mark.parametrize(
+    "minimize, kwargs",
+    [(minimize_lbfgs, {}), (minimize_tron, {}),
+     (minimize_owlqn, {"l1_weight": 0.01})],
+    ids=["lbfgs", "tron", "owlqn"])
+def test_coef_history_recorded(minimize, kwargs):
+    res = minimize(_quad, jnp.zeros(3), track_coefficients=True,
+                   tol=1e-10, **kwargs)
+    hist = np.asarray(res.coef_history)
+    iters = int(res.iterations)
+    assert hist.shape[1] == 3
+    # Row 0 is the start, row `iters` the final iterate.
+    np.testing.assert_allclose(hist[0], np.zeros(3), atol=0)
+    np.testing.assert_allclose(hist[iters], np.asarray(res.x), atol=1e-12)
+
+
+def test_coef_history_off_by_default():
+    res = minimize_lbfgs(_quad, jnp.zeros(3))
+    assert res.coef_history is None
+
+
+def test_model_tracker_from_training():
+    rng = np.random.default_rng(0)
+    n, d = 500, 8
+    x = rng.normal(size=(n, d))
+    x[:, -1] = 1.0
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w)))).astype(float)
+
+    trained = train_glm_models(
+        x, y, TaskType.LOGISTIC_REGRESSION, regularization_weights=[1.0],
+        max_iterations=25, track_models=True)[0]
+    tracker = trained.tracker
+    assert tracker is not None
+    assert tracker.num_iterations == int(trained.result.iterations)
+    assert len(tracker.models) == tracker.num_iterations + 1
+    # Objective values are non-increasing along the recorded states.
+    values = [s.value for s in tracker.states]
+    assert all(b <= a + 1e-9 for a, b in zip(values, values[1:]))
+    # The last tracked model matches the returned model.
+    np.testing.assert_allclose(
+        np.asarray(tracker.models[-1].coefficients.means),
+        np.asarray(trained.model.coefficients.means), atol=1e-12)
+    # States carry finite telemetry.
+    assert all(np.isfinite(s.value) and np.isfinite(s.grad_norm)
+               for s in tracker.states)
+
+
+def test_tracker_absent_by_default():
+    x = np.random.default_rng(1).normal(size=(50, 3))
+    y = (x[:, 0] > 0).astype(float)
+    trained = train_glm_models(
+        x, y, TaskType.LOGISTIC_REGRESSION, regularization_weights=[1.0],
+        max_iterations=5)[0]
+    assert trained.tracker is None
+    assert trained.result.coef_history is None
+
+
+def test_coefficient_summary_stats():
+    s = CoefficientSummary.of([1.0, 2.0, 3.0, 4.0])
+    assert s.count == 4
+    assert s.min == 1.0 and s.max == 4.0
+    assert s.mean == pytest.approx(2.5)
+    assert s.std_dev == pytest.approx(np.std([1, 2, 3, 4], ddof=1))
+    # Reference's sorted-index quantile estimator: sorted[q*n/4].
+    assert s.first_quartile() == 2.0
+    assert s.median() == 3.0
+    assert s.third_quartile() == 4.0
+    assert "# samples = [4]" in str(s)
+
+
+def test_coefficient_summary_empty_is_nan_not_crash():
+    s = CoefficientSummary()
+    assert np.isnan(s.mean) and np.isnan(s.min) and np.isnan(s.max)
+    assert np.isnan(s.median()) and np.isnan(s.first_quartile())
+    assert "# samples = [0]" in str(s)
+
+
+def test_coefficient_summary_single_class():
+    # diagnostics re-exports the same canonical class.
+    from photon_ml_tpu.diagnostics import CoefficientSummary as DiagSummary
+
+    assert DiagSummary is CoefficientSummary
+
+
+def test_metric_metadata():
+    from photon_ml_tpu.evaluation import (
+        METRIC_METADATA,
+        build_evaluator,
+        metadata_for,
+    )
+
+    auc = METRIC_METADATA["AUC"]
+    assert auc.higher_is_better and auc.value_range == (0.0, 1.0)
+    assert not METRIC_METADATA["RMSE"].higher_is_better
+    # metadata_for agrees with each evaluator's own ordering.
+    for spec in ["AUC", "RMSE", "LOGISTIC_LOSS", "AUC:userId",
+                 "PRECISION@5:userId"]:
+        ev = build_evaluator(spec)
+        meta = metadata_for(ev)
+        assert meta.higher_is_better == ev.higher_is_better, spec
+        assert meta.name == ev.name
+    d = auc.to_dict()
+    assert d["higherIsBetter"] is True and d["range"] == (0.0, 1.0)
+
+
+def test_summarize_coefficients_across_models():
+    models = [
+        LogisticRegressionModel(Coefficients(jnp.asarray([0.0, 10.0]))),
+        LogisticRegressionModel(Coefficients(jnp.asarray([2.0, 20.0]))),
+        LogisticRegressionModel(Coefficients(jnp.asarray([4.0, 30.0]))),
+    ]
+    sums = summarize_coefficients(models)
+    assert len(sums) == 2
+    assert sums[0].mean == pytest.approx(2.0)
+    assert sums[1].min == 10.0 and sums[1].max == 30.0
