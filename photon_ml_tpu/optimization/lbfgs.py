@@ -213,7 +213,9 @@ def _minimize_lbfgs_impl(
 
     value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
     gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(gnorm0)
-    coef_hist = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+    # NaN sentinel, like the value/gnorm histories: unwritten trailing rows
+    # are self-identifying rather than masquerading as zero iterates.
+    coef_hist = (jnp.full((max_iter + 1, d), jnp.nan, dtype).at[0].set(x0)
                  if track_coefficients else None)
 
     init = _LoopState(

@@ -90,7 +90,7 @@ def _minimize_owlqn_impl(
 
     value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
     gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(pgnorm0)
-    coef_hist = (jnp.zeros((max_iter + 1, d), dtype).at[0].set(x0)
+    coef_hist = (jnp.full((max_iter + 1, d), jnp.nan, dtype).at[0].set(x0)
                  if track_coefficients else None)
 
     init = _State(

@@ -142,7 +142,8 @@ def _minimize_tron_impl(
 
     value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
     gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(gnorm0)
-    coef_hist = (jnp.zeros((max_iter + 1, x0.shape[-1]), dtype).at[0].set(x0)
+    coef_hist = (jnp.full((max_iter + 1, x0.shape[-1]), jnp.nan,
+                          dtype).at[0].set(x0)
                  if track_coefficients else None)
 
     init = _TronState(

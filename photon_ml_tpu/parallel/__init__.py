@@ -1,10 +1,27 @@
 """Device-mesh parallelism: sharding specs and distributed training helpers."""
 
 from photon_ml_tpu.parallel.distributed import (
+    DATA_AXIS,
+    MODEL_AXIS,
     make_mesh,
-    shard_batch,
-    shard_block,
+    make_mesh_2d,
     replicate,
+    shard_batch,
+    shard_batch_feature_dim,
+    shard_block,
+    shard_coef,
+    unpad_coef,
 )
 
-__all__ = ["make_mesh", "shard_batch", "shard_block", "replicate"]
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "make_mesh_2d",
+    "replicate",
+    "shard_batch",
+    "shard_batch_feature_dim",
+    "shard_block",
+    "shard_coef",
+    "unpad_coef",
+]

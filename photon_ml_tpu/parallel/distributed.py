@@ -38,6 +38,7 @@ from photon_ml_tpu.ops.glm_objective import GLMBatch
 Array = jax.Array
 
 DATA_AXIS = "data"
+MODEL_AXIS = "model"
 
 
 def make_mesh(num_devices: Optional[int] = None,
@@ -50,6 +51,22 @@ def make_mesh(num_devices: Optional[int] = None,
                 f"requested {num_devices} devices, have {len(devs)}")
         devs = devs[:num_devices]
     return Mesh(np.asarray(devs), (axis,))
+
+
+def make_mesh_2d(num_data: int, num_model: int,
+                 data_axis: str = DATA_AXIS,
+                 model_axis: str = MODEL_AXIS) -> Mesh:
+    """2-D (data, model) mesh: batch rows shard over ``data_axis``, the
+    feature/coefficient dimension over ``model_axis``. The TPU analog of the
+    reference's two scale axes — #examples via partitioned RDDs and #features
+    via treeAggregate depth-2 beyond 200k features
+    (GameEstimator.scala:330-334, 523-525)."""
+    devs = jax.devices()
+    need = num_data * num_model
+    if need > len(devs):
+        raise ValueError(f"requested {need} devices, have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(num_data, num_model)
+    return Mesh(grid, (data_axis, model_axis))
 
 
 def _pad_to_multiple(a: np.ndarray | Array, k: int, axis: int,
@@ -108,6 +125,74 @@ def shard_batch(batch: GLMBatch, mesh: Mesh, axis: str = DATA_AXIS
         offsets=jax.device_put(offsets, row_sh),
         weights=jax.device_put(weights, row_sh),
     )
+
+
+def shard_batch_feature_dim(
+    batch: GLMBatch,
+    mesh: Mesh,
+    col_axis: str = DATA_AXIS,
+    row_axis: Optional[str] = None,
+) -> GLMBatch:
+    """Shard a dense GLMBatch's FEATURE (column) dimension over the mesh —
+    the coefficient-sharded mode for d beyond per-chip HBM (SURVEY §5: the
+    reference's #features scale axis, treeAggregate depth 2 past 200k
+    features).
+
+    Columns are zero-padded to a multiple of the mesh extent; the matching
+    coefficient layout comes from :func:`shard_coef`. With X sharded
+    ``P(row?, col_axis)`` and coefficients ``P(col_axis)``, the margin
+    ``X @ w`` compiles to per-device partial products + an ICI psum of
+    partial margins, and the gradient contraction comes back sharded over
+    the coefficient axis — parameters never materialize unsharded anywhere.
+
+    Padded coordinates stay exactly zero during optimization: their data
+    columns are zero, so their smooth gradient is identically zero.
+
+    Pass ``row_axis`` on a 2-D mesh (:func:`make_mesh_2d`) to shard rows and
+    columns simultaneously; rows are padded with weight-0 rows.
+    """
+    feats = batch.features
+    if not isinstance(feats, DenseFeatures):
+        raise TypeError(
+            "feature-dimension sharding requires a dense layout; convert "
+            "CSR shards with .to_dense() first (the d-beyond-HBM regime is "
+            "dense-blocked on TPU)")
+    kc = mesh.shape[col_axis]
+    x = _pad_to_multiple(feats.x, kc, 1, 0.0)
+    labels, offsets, weights = batch.labels, batch.offsets, batch.weights
+    if row_axis is not None:
+        kr = mesh.shape[row_axis]
+        x = _pad_to_multiple(x, kr, 0, 0.0)
+        labels = _pad_to_multiple(labels, kr, 0, 0.0)
+        offsets = _pad_to_multiple(offsets, kr, 0, 0.0)
+        weights = _pad_to_multiple(weights, kr, 0, 0.0)
+    row_sh = NamedSharding(mesh, P(row_axis)) if row_axis else \
+        NamedSharding(mesh, P())
+    return GLMBatch(
+        features=DenseFeatures(jax.device_put(
+            x, NamedSharding(mesh, P(row_axis, col_axis)))),
+        labels=jax.device_put(labels, row_sh),
+        offsets=jax.device_put(offsets, row_sh),
+        weights=jax.device_put(weights, row_sh),
+    )
+
+
+def shard_coef(coef, mesh: Mesh, axis: str = DATA_AXIS) -> Array:
+    """Zero-pad a coefficient vector to a multiple of the mesh extent and
+    shard it over ``axis`` — the layout matching
+    :func:`shard_batch_feature_dim`. Replaces the reference's per-evaluation
+    driver broadcast of coefficients
+    (DistributedObjectiveFunction.scala:56-72) with a permanently
+    device-resident sharded vector."""
+    k = mesh.shape[axis]
+    coef = _pad_to_multiple(jnp.asarray(coef), k, 0, 0.0)
+    return jax.device_put(coef, NamedSharding(mesh, P(axis)))
+
+
+def unpad_coef(coef, num_features: int) -> Array:
+    """Strip feature-dim padding from a (possibly sharded) coefficient
+    vector or [k, d_padded] stack."""
+    return jnp.asarray(coef)[..., :num_features]
 
 
 def shard_block(block: EntityBlock, mesh: Mesh, sentinel_row: int,

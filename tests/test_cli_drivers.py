@@ -84,7 +84,14 @@ def test_glm_driver_avro_end_to_end(tmp_path, rng):
     assert (out / "best-model" / "model.txt").exists()
     assert (out / "best-model" / "model.avro").exists()
     assert (out / "log-message.txt").exists()
-    assert (out / "validation-metrics.json").exists()
+    # validation-metrics.json shape: {"metrics": {λ: {...}},
+    # "metricMetadata": {name: {...}}}
+    vm = json.loads((out / "validation-metrics.json").read_text())
+    assert set(vm) == {"metrics", "metricMetadata"}
+    assert set(vm["metrics"]) == {"10.0", "1.0", "0.1"}
+    assert vm["metrics"][str(summary["bestLambda"])]["AUC"] > 0.6
+    assert vm["metricMetadata"]["AUC"]["higherIsBetter"] is True
+    assert vm["metricMetadata"]["AUC"]["range"] == [0.0, 1.0]
     # text model format: 4 tab-separated columns
     line = (out / "best-model" / "model.txt").read_text().splitlines()[0]
     assert len(line.split("\t")) == 4
