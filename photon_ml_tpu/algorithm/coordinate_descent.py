@@ -16,6 +16,7 @@ per-coordinate "addScoresToOffsets" shuffle is a gather.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -104,7 +105,7 @@ class CoordinateDescent:
         def _save(step):
             ckpt.save_checkpoint(checkpoint_dir, ckpt.CheckpointState(
                 step=step, models=models,
-                objective_history=objective_history,
+                objective_history=_as_floats(objective_history),
                 validation_history=validation_history,
                 best_metric=best_metric,
                 best_models=(dict(best_model.models)
@@ -161,10 +162,14 @@ class CoordinateDescent:
                          else residual + scores[n])
                 timings[n] += time.perf_counter() - t0
 
+                # Device scalar — NOT synced here. A float() per coordinate
+                # update costs a full host<->device round trip; histories are
+                # materialized at checkpoint/return instead.
                 obj = self._training_objective(loss, total, models)
                 objective_history.append(obj)
-                logger.info("iter %d coordinate %s: objective=%.6f", it, n,
-                            obj)
+                if logger.isEnabledFor(logging.INFO):
+                    logger.info("iter %d coordinate %s: objective=%.6f", it,
+                                n, float(obj))
                 # Defer the last-coordinate save to after validation: one
                 # save per iteration boundary, and a crash during validation
                 # resumes from before the final update, so the re-run never
@@ -200,7 +205,7 @@ class CoordinateDescent:
             best_model = final
         return CoordinateDescentResult(
             model=final,
-            objective_history=objective_history,
+            objective_history=_as_floats(objective_history),
             validation_history=validation_history,
             best_model=best_model,
             best_metric=best_metric,
@@ -208,14 +213,16 @@ class CoordinateDescent:
             timings=timings,
         )
 
-    def _training_objective(self, loss, total_scores: Array, models) -> float:
+    def _training_objective(self, loss, total_scores: Array, models):
+        """Full training objective as a DEVICE scalar (one jitted dispatch,
+        no host sync) — the eager version cost several host<->device round
+        trips per coordinate update on a remote chip."""
         labels, offsets, weights = self._training_rows(total_scores.dtype)
-        data_term = jnp.sum(
-            weights * loss.loss(total_scores + offsets, labels))
-        reg = sum(self.coordinates[n].regularization_term(models[n])
-                  for n in self.coordinates)
-        # Single host sync for the whole objective (device scalars only).
-        return float(data_term + reg)
+        penalties = tuple(
+            tuple(self.coordinates[n].penalties(models[n]))
+            for n in self.coordinates)
+        return _objective_impl(loss, total_scores, labels, offsets,
+                               weights, penalties)
 
     def _training_rows(self, dtype) -> Tuple[Array, Array, Array]:
         """(labels, offsets, weights) aligned with the global row order,
@@ -236,6 +243,30 @@ class CoordinateDescent:
             rows = tuple(r.astype(dtype) for r in rows)
         self._rows_cache = rows
         return rows
+
+
+def _as_floats(history) -> List[float]:
+    """Materialize a history of (device-scalar | float) objective values with
+    one batched transfer rather than one sync per entry."""
+    if not history:
+        return []
+    arrs = [v for v in history if isinstance(v, jax.Array)]
+    if arrs:
+        jax.block_until_ready(arrs[-1])
+    return [float(v) for v in history]
+
+
+@functools.partial(jax.jit, static_argnames=("loss",))
+def _objective_impl(loss, total_scores, labels, offsets, weights, penalties):
+    """Full coordinate-descent objective: weighted loss on total scores plus
+    every coordinate's penalty (CoordinateDescent.scala:203-212).
+    ``penalties`` is a nested tuple of (coefs, l1, l2) device triples."""
+    out = jnp.sum(weights * loss.loss(total_scores + offsets, labels))
+    for coord_penalties in penalties:
+        for c, l1, l2 in coord_penalties:
+            out = out + 0.5 * l2 * jnp.sum(jnp.square(c))
+            out = out + l1 * jnp.sum(jnp.abs(c))
+    return out
 
 
 def _rows_from_blocks(ds) -> Tuple[Array, Array, Array]:

@@ -37,7 +37,7 @@ from photon_ml_tpu.ops.features import KroneckerFeatures
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
-from photon_ml_tpu.optimization.solver import regularization_term, solve_glm
+from photon_ml_tpu.optimization.solver import solve_glm
 from photon_ml_tpu.types import TaskType
 
 Array = jax.Array
@@ -57,8 +57,23 @@ class Coordinate:
     def initialize_model(self):
         raise NotImplementedError
 
-    def regularization_term(self, model) -> float:
+    def penalties(self, model) -> List[Tuple[Array, Array, Array]]:
+        """(coefficients, l1, l2) triples in the optimization space — the
+        coordinate's contribution to the coordinate-descent objective
+        (CoordinateDescent.scala:203-212). l1/l2 are device scalars so the
+        whole objective evaluates inside one jitted call."""
         raise NotImplementedError
+
+    def regularization_term(self, model) -> float:
+        return sum(
+            0.5 * l2 * jnp.sum(jnp.square(c)) + l1 * jnp.sum(jnp.abs(c))
+            for c, l1, l2 in self.penalties(model))
+
+
+def _l1_l2(config: GLMOptimizationConfiguration) -> Tuple[float, float]:
+    lam = config.regularization_weight
+    rc = config.regularization_context
+    return rc.l1_weight(lam), rc.l2_weight(lam)
 
 
 @dataclasses.dataclass
@@ -85,6 +100,11 @@ class FixedEffectCoordinate(Coordinate):
             self._batch = shard_batch(self._batch, self.mesh)
         self._objective = GLMObjective(
             loss_for_task(self.task_type), self.normalization)
+        # Penalty scalars device-resident once — rebuilding them per
+        # objective evaluation is a host->device transfer each.
+        l1, l2 = _l1_l2(self.config)
+        self._l1 = jnp.asarray(l1, self.dtype)
+        self._l2 = jnp.asarray(l2, self.dtype)
 
     def initialize_model(self) -> FixedEffectModel:
         d = self.data.feature_shards[self.feature_shard_id].shape[1]
@@ -97,35 +117,16 @@ class FixedEffectCoordinate(Coordinate):
         self, model: FixedEffectModel, residual_scores: Optional[Array],
         rng_key,
     ) -> Tuple[FixedEffectModel, object]:
-        batch = self._batch
-        if residual_scores is not None:
-            # The batch may be row-padded for sharding; pad the residual with
-            # zeros to match (padding rows have weight 0, so the value added
-            # there is irrelevant).
-            pad = batch.num_rows - residual_scores.shape[0]
-            if pad:
-                residual_scores = jnp.concatenate(
-                    [residual_scores,
-                     jnp.zeros((pad,), residual_scores.dtype)])
-            batch = batch.with_offsets(
-                batch.offsets + residual_scores.astype(batch.offsets.dtype))
-        weights = down_sample_weights(
-            rng_key, batch.labels, batch.weights,
-            self.config.down_sampling_rate,
-            self.task_type.is_classification)
-        batch = GLMBatch(batch.features, batch.labels, batch.offsets, weights)
         # Models live in the ORIGINAL feature space; the solve happens in the
         # normalized space (reference: the estimator converts trained
-        # coefficients back through the NormalizationContext).
-        coef0 = model.glm.coefficients.means
-        if self.normalization is not None:
-            coef0 = self.normalization.model_to_normalized_space(coef0)
-        result = solve_glm(
-            self._objective, batch, self.config, coef0,
-            self.lower_bounds, self.upper_bounds)
-        coef = result.x
-        if self.normalization is not None:
-            coef = self.normalization.model_to_original_space(coef)
+        # coefficients back through the NormalizationContext). Residual
+        # padding, down-sampling, the space transforms and the solve all run
+        # as one jitted dispatch.
+        result, coef = _solve_fixed(
+            self._objective, self.config, self.task_type.is_classification,
+            self._batch, residual_scores, rng_key,
+            model.glm.coefficients.means, self.lower_bounds,
+            self.upper_bounds, self.normalization)
         from photon_ml_tpu.models.coefficients import Coefficients
         new_glm = model.glm.update_coefficients(Coefficients(coef))
         return model.update_model(new_glm), result
@@ -134,16 +135,18 @@ class FixedEffectCoordinate(Coordinate):
         # Original-space coefficients against raw features — consistent with
         # host-side scoring (FixedEffectModel.score_numpy). The batch may be
         # row-padded for sharding; scores are truncated to the true row count
-        # so they align with other coordinates' score vectors.
-        return model.glm.compute_score(
-            self._batch.features)[: self.data.num_rows]
+        # so they align with other coordinates' score vectors. One jitted
+        # dispatch (matvec + slice fused).
+        return _fe_score_impl(model.glm.coefficients.means,
+                              self._batch.features,
+                              n_rows=self.data.num_rows)
 
-    def regularization_term(self, model: FixedEffectModel) -> float:
+    def penalties(self, model: FixedEffectModel):
         # The penalty applies in the optimization (normalized) space.
         coef = model.glm.coefficients.means
         if self.normalization is not None:
             coef = self.normalization.model_to_normalized_space(coef)
-        return regularization_term(self.config, coef)
+        return [(coef, self._l1, self._l2)]
 
 
 @dataclasses.dataclass
@@ -161,6 +164,11 @@ class RandomEffectCoordinate(Coordinate):
         if self.mesh is not None:
             self.dataset = _shard_re_dataset(self.dataset, self.mesh)
         self._objective = GLMObjective(loss_for_task(self.task_type))
+        l1, l2 = _l1_l2(self.config)
+        dt = (self.dataset.blocks[0].x.dtype if self.dataset.blocks
+              else jnp.float32)
+        self._l1 = jnp.asarray(l1, dt)
+        self._l2 = jnp.asarray(l2, dt)
 
     def initialize_model(self) -> RandomEffectModel:
         return RandomEffectModel.zeros_like_dataset(self.dataset)
@@ -175,34 +183,22 @@ class RandomEffectCoordinate(Coordinate):
         new_coefs = []
         trackers = []
         for block, coefs in zip(self.dataset.blocks, model.local_coefs):
-            extra = _gather_residual(residual_scores, block,
-                                     self.dataset.n_rows)
             result = _solve_block(
-                self._objective, self.config, block, extra, coefs)
+                self._objective, self.config, block, residual_scores, coefs)
             new_coefs.append(result.x)
             trackers.append(result)
         return model.with_coefs(new_coefs), trackers
 
     def score(self, model: RandomEffectModel) -> Array:
-        margins = []
-        passive_margins = []
-        for block, coefs in zip(self.dataset.blocks, model.local_coefs):
-            m = block.local_margins(coefs)
-            margins.append(jnp.where(block.row_ids < self.dataset.n_rows,
-                                     m, 0.0))
-        for pblock, coefs in zip(self.dataset.passive_blocks,
-                                 model.local_coefs):
-            if pblock is None:
-                passive_margins.append(None)
-            else:
-                m = pblock.local_margins(coefs)
-                passive_margins.append(
-                    jnp.where(pblock.row_ids < self.dataset.n_rows, m, 0.0))
-        return self.dataset.scatter_scores(margins, passive_margins)
+        """All bucket margins + the scatter assembly as ONE jitted dispatch
+        (the eager per-block einsum/where/scatter chain costs several
+        host->device round trips per call on a remote chip)."""
+        return _re_score_impl(
+            tuple(self.dataset.blocks), tuple(self.dataset.passive_blocks),
+            tuple(model.local_coefs), n_rows=self.dataset.n_rows)
 
-    def regularization_term(self, model: RandomEffectModel) -> float:
-        return sum(regularization_term(self.config, c)
-                   for c in model.local_coefs)
+    def penalties(self, model: RandomEffectModel):
+        return [(c, self._l1, self._l2) for c in model.local_coefs]
 
 
 def _shard_re_dataset(dataset: RandomEffectDataset, mesh
@@ -266,6 +262,13 @@ class FactoredRandomEffectCoordinate(Coordinate):
         if self.mesh is not None:
             self.dataset = _shard_re_dataset(self.dataset, self.mesh)
         self._objective = GLMObjective(loss_for_task(self.task_type))
+        l1, l2 = _l1_l2(self.config)
+        ll1, ll2 = _l1_l2(self.latent_config)
+        dt = self._dtype
+        self._l1 = jnp.asarray(l1, dt)
+        self._l2 = jnp.asarray(l2, dt)
+        self._ll1 = jnp.asarray(ll1, dt)
+        self._ll2 = jnp.asarray(ll2, dt)
 
     @property
     def _dtype(self):
@@ -326,29 +329,20 @@ class FactoredRandomEffectCoordinate(Coordinate):
 
     def score(self, model) -> Array:
         ds = self.dataset
-        d = ds.num_global_features
         B = jnp.asarray(model.projection_matrix, self._dtype)
-        gammas = [jnp.asarray(g, self._dtype)
-                  for g in model.latent.local_coefs]
+        gammas = tuple(jnp.asarray(g, self._dtype)
+                       for g in model.latent.local_coefs)
+        return _fre_score_impl(
+            tuple(ds.blocks), tuple(ds.passive_blocks), gammas, B,
+            n_rows=ds.n_rows, d=ds.num_global_features)
 
-        def block_margins(block, gamma):
-            coefs = gamma @ B  # [E, d]
-            pad = block.d_pad - d
-            if pad:
-                coefs = jnp.pad(coefs, ((0, 0), (0, pad)))
-            m = block.local_margins(coefs)
-            return jnp.where(block.row_ids < ds.n_rows, m, 0.0)
-
-        margins = [block_margins(b, g) for b, g in zip(ds.blocks, gammas)]
-        passive = [None if b is None else block_margins(b, g)
-                   for b, g in zip(ds.passive_blocks, gammas)]
-        return ds.scatter_scores(margins, passive)
-
-    def regularization_term(self, model) -> float:
-        total = sum(regularization_term(self.config, g)
-                    for g in model.latent.local_coefs)
-        return total + regularization_term(
-            self.latent_config, jnp.asarray(model.projection_matrix))
+    def penalties(self, model):
+        dt = self._dtype
+        out = [(jnp.asarray(g, dt), self._l1, self._l2)
+               for g in model.latent.local_coefs]
+        B = jnp.asarray(model.projection_matrix, dt)
+        out.append((B, self._ll1, self._ll2))
+        return out
 
 
 @functools.partial(jax.jit, static_argnames=("objective", "config", "d"))
@@ -420,14 +414,18 @@ def _gather_residual(residual_scores: Optional[Array], block: EntityBlock,
 @functools.partial(jax.jit, static_argnames=("objective", "config"))
 def _solve_block(
     objective: GLMObjective, config: GLMOptimizationConfiguration,
-    block: EntityBlock, extra_offsets, coefs0,
+    block: EntityBlock, residual_scores, coefs0,
 ):
     """One vmapped solve over the bucket's entity axis, jitted so the whole
     batched solve (trace included) is cached across coordinate-descent
     iterations. ``objective`` hashes by identity and ``config`` by value —
-    both stable for a persistent coordinate."""
-    offsets = block.offsets if extra_offsets is None else \
-        block.offsets + extra_offsets.astype(block.offsets.dtype)
+    both stable for a persistent coordinate. The residual gather (the
+    reference's addScoresToOffsets join) fuses into the same dispatch."""
+    offsets = block.offsets
+    if residual_scores is not None:
+        ext = jnp.concatenate(
+            [residual_scores, jnp.zeros((1,), residual_scores.dtype)])
+        offsets = offsets + ext[block.row_ids].astype(offsets.dtype)
 
     def fit_one(coef0, x, y, off, w):
         from photon_ml_tpu.ops.features import DenseFeatures
@@ -436,3 +434,80 @@ def _solve_block(
 
     return jax.vmap(fit_one)(coefs0, block.x, block.labels, offsets,
                              block.weights)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("objective", "config", "is_classification"))
+def _solve_fixed(
+    objective: GLMObjective, config: GLMOptimizationConfiguration,
+    is_classification: bool, batch: GLMBatch, residual_scores, rng_key,
+    coef0, lower_bounds, upper_bounds, normalization,
+):
+    """The full fixed-effect update as one dispatch: residual->offsets,
+    down-sampling, normalized-space solve, back-transform."""
+    if residual_scores is not None:
+        # The batch may be row-padded for sharding; pad the residual with
+        # zeros to match (padding rows have weight 0, so the value added
+        # there is irrelevant).
+        pad = batch.num_rows - residual_scores.shape[0]
+        if pad:
+            residual_scores = jnp.concatenate(
+                [residual_scores, jnp.zeros((pad,), residual_scores.dtype)])
+        batch = batch.with_offsets(
+            batch.offsets + residual_scores.astype(batch.offsets.dtype))
+    weights = down_sample_weights(
+        rng_key, batch.labels, batch.weights, config.down_sampling_rate,
+        is_classification)
+    batch = GLMBatch(batch.features, batch.labels, batch.offsets, weights)
+    if normalization is not None:
+        coef0 = normalization.model_to_normalized_space(coef0)
+    result = solve_glm(objective, batch, config, coef0,
+                       lower_bounds, upper_bounds)
+    coef = result.x
+    if normalization is not None:
+        coef = normalization.model_to_original_space(coef)
+    return result, coef
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def _fe_score_impl(coef, feats, n_rows: int):
+    return feats.matvec(coef)[:n_rows]
+
+
+def _scatter_margins(scores, block, margins, n_rows):
+    m = jnp.where(block.row_ids < n_rows, margins, 0.0)
+    return scores.at[block.row_ids.reshape(-1)].add(m.reshape(-1))
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def _re_score_impl(blocks, pblocks, coefs, n_rows: int):
+    scores = jnp.zeros((n_rows + 1,),
+                       coefs[0].dtype if coefs else jnp.float32)
+    for block, c in zip(blocks, coefs):
+        scores = _scatter_margins(scores, block, block.local_margins(c),
+                                  n_rows)
+    for block, c in zip(pblocks, coefs):
+        if block is not None:
+            scores = _scatter_margins(scores, block, block.local_margins(c),
+                                      n_rows)
+    return scores[:-1]
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows", "d"))
+def _fre_score_impl(blocks, pblocks, gammas, B, n_rows: int, d: int):
+    def block_margins(block, gamma):
+        coefs = gamma @ B  # [E, d]
+        pad = block.d_pad - d
+        if pad:
+            coefs = jnp.pad(coefs, ((0, 0), (0, pad)))
+        return block.local_margins(coefs)
+
+    scores = jnp.zeros((n_rows + 1,), B.dtype)
+    for block, g in zip(blocks, gammas):
+        scores = _scatter_margins(scores, block, block_margins(block, g),
+                                  n_rows)
+    for block, g in zip(pblocks, gammas):
+        if block is not None:
+            scores = _scatter_margins(scores, block, block_margins(block, g),
+                                      n_rows)
+    return scores[:-1]
