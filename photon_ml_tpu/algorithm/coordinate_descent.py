@@ -103,9 +103,12 @@ class CoordinateDescent:
                 "taskType": self.task_type.value, "tag": checkpoint_tag}
 
         def _save(step):
+            # Materialize IN PLACE so each device scalar is transferred
+            # exactly once across the run, not once per checkpoint.
+            objective_history[:] = _as_floats(objective_history)
             ckpt.save_checkpoint(checkpoint_dir, ckpt.CheckpointState(
                 step=step, models=models,
-                objective_history=_as_floats(objective_history),
+                objective_history=list(objective_history),
                 validation_history=validation_history,
                 best_metric=best_metric,
                 best_models=(dict(best_model.models)
@@ -136,7 +139,6 @@ class CoordinateDescent:
 
         scores: Dict[str, Array] = {
             n: self.coordinates[n].score(models[n]) for n in names}
-        total = jnp.sum(jnp.stack(list(scores.values())), axis=0)
 
         validating = (self.validation_data is not None
                       and bool(self.validation_evaluators))
@@ -153,7 +155,13 @@ class CoordinateDescent:
                 sub = jax.random.fold_in(base_key, step)
                 # Single coordinate: residual is None (no other scores) —
                 # mirrors CoordinateDescent.scala's descend-only-one branch.
-                residual = None if len(names) == 1 else total - scores[n]
+                # The residual is reduced FRESH from the other coordinates'
+                # scores every step (the reference's partial-score reduce,
+                # CoordinateDescent.scala:150-158) rather than kept as a
+                # running total: identical models then take an identical
+                # arithmetic path, which is what makes a resumed run match
+                # an uninterrupted one bit-for-bit in f32.
+                residual = _residual_of_others(scores, names, n)
                 models[n], tracker = coord.update_model(
                     models[n], residual, sub)
                 trackers[n].append(tracker)
@@ -243,6 +251,16 @@ class CoordinateDescent:
             rows = tuple(r.astype(dtype) for r in rows)
         self._rows_cache = rows
         return rows
+
+
+def _residual_of_others(scores: Dict[str, Array], names: Sequence[str],
+                        current: str) -> Optional[Array]:
+    others = [scores[m] for m in names if m != current]
+    if not others:
+        return None
+    if len(others) == 1:
+        return others[0]
+    return jnp.sum(jnp.stack(others), axis=0)
 
 
 def _as_floats(history) -> List[float]:

@@ -64,11 +64,6 @@ class Coordinate:
         whole objective evaluates inside one jitted call."""
         raise NotImplementedError
 
-    def regularization_term(self, model) -> float:
-        return sum(
-            0.5 * l2 * jnp.sum(jnp.square(c)) + l1 * jnp.sum(jnp.abs(c))
-            for c, l1, l2 in self.penalties(model))
-
 
 def _l1_l2(config: GLMOptimizationConfiguration) -> Tuple[float, float]:
     lam = config.regularization_weight
@@ -306,7 +301,7 @@ class FactoredRandomEffectCoordinate(Coordinate):
         B = jnp.asarray(model.projection_matrix, self._dtype)
         gammas = [jnp.asarray(g, self._dtype)
                   for g in model.latent.local_coefs]
-        residuals = [_gather_residual(residual_scores, b, ds.n_rows)
+        residuals = [_gather_residual(residual_scores, b)
                      for b in ds.blocks]
         # Row-major view of x/labels/offsets/weights is iteration-invariant;
         # only the per-row gammas change across alternations.
@@ -401,8 +396,10 @@ def _solve_latent_matrix(
     return solve_glm(objective, batch, config, coef0)
 
 
-def _gather_residual(residual_scores: Optional[Array], block: EntityBlock,
-                     n_rows: int) -> Optional[Array]:
+def _gather_residual(residual_scores: Optional[Array],
+                     block: EntityBlock) -> Optional[Array]:
+    """Per-row residual for a block: a zero sentinel slot is appended so
+    padding rows (row_ids == n_rows) gather 0."""
     if residual_scores is None:
         return None
     ext = jnp.concatenate(
@@ -422,10 +419,9 @@ def _solve_block(
     both stable for a persistent coordinate. The residual gather (the
     reference's addScoresToOffsets join) fuses into the same dispatch."""
     offsets = block.offsets
-    if residual_scores is not None:
-        ext = jnp.concatenate(
-            [residual_scores, jnp.zeros((1,), residual_scores.dtype)])
-        offsets = offsets + ext[block.row_ids].astype(offsets.dtype)
+    extra = _gather_residual(residual_scores, block)
+    if extra is not None:
+        offsets = offsets + extra.astype(offsets.dtype)
 
     def fit_one(coef0, x, y, off, w):
         from photon_ml_tpu.ops.features import DenseFeatures

@@ -136,6 +136,37 @@ class GLMObjective:
     def gradient(self, coef, batch, l2_weight=0.0) -> Array:
         return self.value_and_grad(coef, batch, l2_weight)[1]
 
+    def margin_direction(self, direction: Array, batch: GLMBatch) -> Array:
+        """Directional margins: margins are affine in coef, so
+        margins(coef + t d) = margins(coef) + t * margin_direction(d).
+        This is what lets a line search re-price trial points in O(n)
+        (see optimization/glm_lbfgs.py)."""
+        return self.margins(direction, batch) - batch.offsets
+
+    def value_from_margins(self, z: Array, coef_sq_norm,
+                           batch: GLMBatch, l2_weight) -> Array:
+        """Objective value given precomputed margins — no feature contraction."""
+        return (jnp.sum(batch.weights * self.loss.loss(z, batch.labels))
+                + 0.5 * l2_weight * coef_sq_norm)
+
+    def gradient_from_margins(
+        self, coef: Array, z: Array, batch: GLMBatch,
+        l2_weight: Array | float = 0.0,
+    ) -> Array:
+        """Gradient given precomputed margins: one feature contraction
+        (X^T u) instead of the matvec+rmatvec pair jax.grad(value) issues.
+        The normalization chain rule mirrors the reference's hand-coded
+        factor/shift algebra (ValueAndGradientAggregator.scala:133-154)."""
+        u = batch.weights * self.loss.d1(z, batch.labels)
+        r = batch.features.rmatvec(u)
+        norm = self.normalization
+        if norm is not None:
+            if norm.shifts is not None:
+                r = r - jnp.sum(u) * norm.shifts
+            if norm.factors is not None:
+                r = r * norm.factors
+        return r + l2_weight * coef
+
     # -- second-order -----------------------------------------------------
 
     def hessian_vector(

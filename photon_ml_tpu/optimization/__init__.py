@@ -15,6 +15,7 @@ from photon_ml_tpu.optimization.convergence import (
     OptimizerResult,
 )
 from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimization.newton import minimize_newton
 from photon_ml_tpu.optimization.owlqn import minimize_owlqn
 from photon_ml_tpu.optimization.tron import minimize_tron
 from photon_ml_tpu.optimization.config import (
@@ -30,6 +31,7 @@ __all__ = [
     "ConvergenceReason",
     "OptimizerResult",
     "minimize_lbfgs",
+    "minimize_newton",
     "minimize_owlqn",
     "minimize_tron",
     "OptimizerType",

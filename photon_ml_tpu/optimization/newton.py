@@ -1,0 +1,203 @@
+"""Damped (Levenberg-style) exact Newton for SMALL dense problems.
+
+The TPU fast path for the per-entity random-effect solves: after feature
+selection/projection, entity problems have d of order 8-64
+(RandomEffectDataConfiguration.num_features_to_samples_ratio caps them,
+reference ml/data/RandomEffectDataSet.scala:380-394). At those sizes the
+exact Hessian is a tiny matrix and a direct solve replaces both the L-BFGS
+two-loop recursion and TRON's inner CG — the same trust-region-Newton family
+as the reference's TRON (ml/optimization/TRON.scala), with the truncated CG
+degenerating to an exact solve because the full system fits in registers.
+
+Why it's faster on TPU: one vmapped iteration is ~6 fused batched ops
+(Hessian einsum, add damping, linalg.solve, objective eval, compares)
+instead of the hundreds of sequential micro-ops a batched L-BFGS iteration
+issues (two-loop fori, line-search while) — under `vmap` over thousands of
+entities the op-dispatch depth, not FLOPs, is the bottleneck.
+
+Damping loop per iteration (branch-free, masked for vmap):
+  step = -(H + damping I)^{-1} g; accept if f decreases (damping shrinks),
+  else reject and grow damping — the Levenberg analog of TRON's
+  trust-region radius update (TRON.scala:153-255).
+
+Convergence semantics follow ml/optimization/Optimizer.scala:156-170,
+identical to lbfgs.py/tron.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optimization.convergence import (
+    ConvergenceReason,
+    OptimizerResult,
+)
+from photon_ml_tpu.optimization.lbfgs import _project
+
+Array = jax.Array
+
+_DAMP_INIT = 1e-4
+_DAMP_SHRINK = 0.3
+_DAMP_GROW = 10.0
+_DAMP_MAX = 1e10
+
+
+class _NewtonState(NamedTuple):
+    x: Array
+    f: Array
+    g: Array
+    damping: Array
+    it: Array  # accepted iterations
+    fails: Array  # consecutive rejected steps
+    reason: Array
+    value_hist: Array
+    gnorm_hist: Array
+    coef_hist: Optional[Array]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fun", "max_iter", "tol", "max_improvement_failures",
+                     "has_bounds", "track_coefficients"),
+)
+def _minimize_newton_impl(
+    fun, x0, args, lower, upper, *, max_iter, tol,
+    max_improvement_failures, has_bounds, track_coefficients=False,
+) -> OptimizerResult:
+    vg = jax.value_and_grad(fun)
+    hess = jax.hessian(fun)
+    dtype = x0.dtype
+    d = x0.shape[-1]
+    lo = lower if has_bounds else None
+    hi = upper if has_bounds else None
+
+    x0 = _project(x0, lo, hi)
+    f0, g0 = vg(x0, *args)
+    gnorm0 = jnp.linalg.norm(g0)
+    f0_scale = jnp.maximum(jnp.abs(f0), jnp.asarray(1e-30, dtype))
+
+    value_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(f0)
+    gnorm_hist = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+    coef_hist = (jnp.full((max_iter + 1, d), jnp.nan, dtype).at[0].set(x0)
+                 if track_coefficients else None)
+
+    init = _NewtonState(
+        x=x0, f=f0, g=g0,
+        damping=jnp.asarray(_DAMP_INIT, dtype),
+        it=jnp.zeros((), jnp.int32), fails=jnp.zeros((), jnp.int32),
+        reason=jnp.where(
+            gnorm0 <= 0.0, int(ConvergenceReason.GRADIENT_CONVERGED),
+            int(ConvergenceReason.NOT_CONVERGED)).astype(jnp.int32),
+        value_hist=value_hist, gnorm_hist=gnorm_hist, coef_hist=coef_hist,
+    )
+
+    eye = jnp.eye(d, dtype=dtype)
+
+    def cond(st: _NewtonState):
+        return st.reason == int(ConvergenceReason.NOT_CONVERGED)
+
+    def body(st: _NewtonState):
+        H = hess(st.x, *args)
+        step = -jnp.linalg.solve(H + st.damping * eye, st.g)
+        # A singular/indefinite system yields non-finite entries; treat as a
+        # rejected step (damping grows until H + damping I is safely PD).
+        step_ok = jnp.all(jnp.isfinite(step))
+        x_try = _project(
+            st.x + jnp.where(step_ok, step, jnp.zeros_like(step)), lo, hi)
+        f_new, g_new = vg(x_try, *args)
+
+        accept = jnp.logical_and(
+            jnp.logical_and(step_ok, jnp.isfinite(f_new)), f_new < st.f)
+        damping = jnp.where(
+            accept,
+            jnp.maximum(st.damping * _DAMP_SHRINK, 1e-12),
+            jnp.minimum(st.damping * _DAMP_GROW, _DAMP_MAX))
+        it_new = st.it + jnp.where(accept, 1, 0).astype(jnp.int32)
+        fails_new = jnp.where(accept, 0, st.fails + 1).astype(jnp.int32)
+
+        x_acc = jnp.where(accept, x_try, st.x)
+        f_acc = jnp.where(accept, f_new, st.f)
+        g_acc = jnp.where(accept, g_new, st.g)
+        gnorm_acc = jnp.linalg.norm(g_acc)
+        f_delta = jnp.abs(st.f - f_acc)
+
+        reason = jnp.where(
+            fails_new > max_improvement_failures,
+            int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
+            jnp.where(
+                jnp.logical_and(accept, gnorm_acc <= tol * gnorm0),
+                int(ConvergenceReason.GRADIENT_CONVERGED),
+                jnp.where(
+                    jnp.logical_and(accept, f_delta <= tol * f0_scale),
+                    int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
+                    jnp.where(
+                        it_new >= max_iter,
+                        int(ConvergenceReason.MAX_ITERATIONS),
+                        int(ConvergenceReason.NOT_CONVERGED)))),
+        ).astype(jnp.int32)
+
+        new = _NewtonState(
+            x=x_acc, f=f_acc, g=g_acc, damping=damping, it=it_new,
+            fails=fails_new, reason=reason,
+            value_hist=jnp.where(
+                accept, st.value_hist.at[it_new].set(f_acc), st.value_hist),
+            gnorm_hist=jnp.where(
+                accept, st.gnorm_hist.at[it_new].set(gnorm_acc),
+                st.gnorm_hist),
+            coef_hist=(None if st.coef_hist is None
+                       else jnp.where(
+                           accept, st.coef_hist.at[it_new].set(x_acc),
+                           st.coef_hist)),
+        )
+        done = ~cond(st)
+        return jax.tree.map(lambda a, b: jnp.where(done, a, b), st, new)
+
+    final = lax.while_loop(cond, body, init)
+    return OptimizerResult(
+        x=final.x, value=final.f, grad_norm=jnp.linalg.norm(final.g),
+        iterations=final.it, reason=final.reason,
+        value_history=final.value_hist, grad_norm_history=final.gnorm_hist,
+        coef_history=final.coef_hist,
+    )
+
+
+def minimize_newton(
+    fun: Callable[..., Array],
+    x0: Array,
+    args: Tuple[Any, ...] = (),
+    *,
+    max_iter: int = 15,
+    tol: float = 1e-5,
+    max_improvement_failures: int = 25,
+    lower_bounds: Optional[Array] = None,
+    upper_bounds: Optional[Array] = None,
+    track_coefficients: bool = False,
+) -> OptimizerResult:
+    """Minimize twice-differentiable ``fun(x, *args)`` from ``x0`` with
+    damped exact Newton. Intended for small d (the full Hessian is
+    materialized). NOT auto-routed by `solve_glm`: batched tiny
+    `linalg.solve` lowers to slow unrolled LU on TPU (measured far slower
+    than the vmapped L-BFGS there) — use explicitly, e.g. for CPU f64
+    solves. Defaults mirror TRON's budget (maxIter=15, tol=1e-5;
+    ml/optimization/TRON.scala:258-264). max_improvement_failures is higher
+    than TRON's because a rejected damped step is much cheaper than a
+    rejected trust-region step (no CG inside).
+    """
+    x0 = jnp.asarray(x0)
+    dtype = x0.dtype
+    has_bounds = lower_bounds is not None or upper_bounds is not None
+    d = x0.shape[-1]
+    lo = (jnp.full((d,), -jnp.inf, dtype) if lower_bounds is None
+          else jnp.asarray(lower_bounds, dtype))
+    hi = (jnp.full((d,), jnp.inf, dtype) if upper_bounds is None
+          else jnp.asarray(upper_bounds, dtype))
+    return _minimize_newton_impl(
+        fun, x0, args, lo, hi, max_iter=max_iter, tol=tol,
+        max_improvement_failures=max_improvement_failures,
+        has_bounds=has_bounds, track_coefficients=track_coefficients,
+    )
