@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Convert a LibSVM text file into TrainingExampleAvro records.
+
+Counterpart of the reference's dev script
+(dev-scripts/libsvm_text_to_trainingexample_avro.py): each feature index
+becomes the feature ``name``; ``term`` is empty. Classification labels
+-1/+1 map to 0/1 unless --regression is given.
+
+Usage:
+  python dev_scripts/libsvm_text_to_trainingexample_avro.py \
+      INPUT.libsvm OUTPUT_DIR [--regression] [--zero-based]
+
+Writes OUTPUT_DIR/part-00000.avro readable by the GLM/GAME drivers
+(--format AVRO). No external Avro dependency — uses the bundled pure-python
+container codec (photon_ml_tpu/io/avro_codec.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from photon_ml_tpu.io import schemas  # noqa: E402
+from photon_ml_tpu.io.avro_codec import write_container  # noqa: E402
+
+
+def convert(input_path: Path, output_dir: Path, regression: bool,
+            zero_based: bool) -> int:
+    records = []
+    with open(input_path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            try:
+                label = float(parts[0])
+                feats = []
+                for tok in parts[1:]:
+                    idx_s, val_s = tok.split(":", 1)
+                    idx = int(idx_s) - (0 if zero_based else 1)
+                    feats.append({"name": str(idx), "term": None,
+                                  "value": float(val_s)})
+            except (ValueError, IndexError) as e:
+                raise SystemExit(
+                    f"{input_path}:{lineno}: malformed line ({e})")
+            if not regression:
+                label = 1.0 if label > 0 else 0.0
+            records.append({
+                "uid": str(lineno), "label": label, "features": feats,
+                "weight": None, "offset": None, "metadataMap": None,
+            })
+    output_dir.mkdir(parents=True, exist_ok=True)
+    write_container(output_dir / "part-00000.avro",
+                    schemas.TRAINING_EXAMPLE, records)
+    return len(records)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input", type=Path)
+    p.add_argument("output_dir", type=Path)
+    p.add_argument("-r", "--regression", action="store_true",
+                   help="keep raw labels (no -1/+1 -> 0/1 mapping)")
+    p.add_argument("--zero-based", action="store_true",
+                   help="feature indices in the input start at 0, not 1")
+    args = p.parse_args(argv)
+    n = convert(args.input, args.output_dir, args.regression,
+                args.zero_based)
+    print(f"wrote {n} records to {args.output_dir}/part-00000.avro")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Generate small synthetic Avro datasets for the example scripts.
+
+Creates under DATA_DIR (default ./example-data):
+  glm/train, glm/validate      — logistic regression TrainingExampleAvro
+  game/train, game/validate    — GLMix-shaped data: global features + a
+                                 per-user bias, userId in metadataMap
+
+The generating model is y ~ Bernoulli(sigmoid(x.w + bias_user)), so the GAME
+run demonstrably beats the fixed effect alone on AUC.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from photon_ml_tpu.io import schemas  # noqa: E402
+from photon_ml_tpu.io.avro_codec import write_container  # noqa: E402
+
+
+def _write(path: Path, records) -> None:
+    path.mkdir(parents=True, exist_ok=True)
+    write_container(path / "part-00000.avro", schemas.TRAINING_EXAMPLE,
+                    records)
+    print(f"wrote {len(records)} records to {path}")
+
+
+def glm_records(rng, n, w):
+    d = len(w) - 1
+    out = []
+    for i in range(n):
+        x = rng.normal(0, 1, d)
+        z = float(x @ w[:-1] + w[-1])
+        out.append({
+            "uid": f"u{i}",
+            "label": float(rng.random() < 1 / (1 + np.exp(-z))),
+            "features": [{"name": f"f{j}", "term": None, "value": float(v)}
+                         for j, v in enumerate(x)],
+            "weight": None, "offset": None, "metadataMap": None,
+        })
+    return out
+
+
+def game_records(rng, n, w, user_bias):
+    out = []
+    for i in range(n):
+        u = int(rng.integers(0, len(user_bias)))
+        x = rng.normal(0, 1, len(w))
+        z = float(x @ w + user_bias[u])
+        out.append({
+            "uid": f"r{i}",
+            "label": float(rng.random() < 1 / (1 + np.exp(-z))),
+            "features": [{"name": f"x{j}", "term": None, "value": float(v)}
+                         for j, v in enumerate(x)],
+            "weight": None, "offset": None,
+            "metadataMap": {"userId": f"user{u}"},
+        })
+    return out
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-dir", type=Path, default=Path("example-data"))
+    p.add_argument("--seed", type=int, default=17)
+    p.add_argument("--num-train", type=int, default=2000)
+    p.add_argument("--num-validate", type=int, default=600)
+    p.add_argument("--num-users", type=int, default=40)
+    args = p.parse_args(argv)
+    rng = np.random.default_rng(args.seed)
+
+    w_glm = rng.normal(0, 1, 9)  # 8 features + intercept
+    _write(args.data_dir / "glm" / "train",
+           glm_records(rng, args.num_train, w_glm))
+    _write(args.data_dir / "glm" / "validate",
+           glm_records(rng, args.num_validate, w_glm))
+
+    w_game = rng.normal(0, 1, 5)
+    bias = rng.normal(0, 1.5, args.num_users)
+    _write(args.data_dir / "game" / "train",
+           game_records(rng, args.num_train, w_game, bias))
+    _write(args.data_dir / "game" / "validate",
+           game_records(rng, args.num_validate, w_game, bias))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# End-to-end GAME (GLMix) demo: a global fixed effect plus per-user random
+# effects trained by block coordinate descent, then batch scoring with the
+# saved model — the pipeline of the reference's cli/game/training and
+# cli/game/scoring drivers.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA_DIR="${DATA_DIR:-example-data}"
+OUT_DIR="${OUT_DIR:-example-out/game}"
+
+[ -d "$DATA_DIR/game/train" ] || python examples/generate_example_data.py --data-dir "$DATA_DIR"
+rm -rf "$OUT_DIR"
+
+python -m photon_ml_tpu.cli.game_training_driver \
+  --train-input-dirs "$DATA_DIR/game/train" \
+  --validate-input-dirs "$DATA_DIR/game/validate" \
+  --output-dir "$OUT_DIR/model" \
+  --task-type LOGISTIC_REGRESSION \
+  --fixed-effect-data-configurations "fixed:global" \
+  --fixed-effect-optimization-configurations "fixed:50,1e-7,1.0,1.0,LBFGS,L2" \
+  --random-effect-data-configurations "perUser:userId,global,4,-1,-1,-1" \
+  --random-effect-optimization-configurations "perUser:30,1e-7,1.0,1.0,LBFGS,L2" \
+  --updating-sequence fixed,perUser \
+  --num-iterations 3 \
+  --evaluators AUC,LOGISTIC_LOSS
+
+python -m photon_ml_tpu.cli.game_scoring_driver \
+  --input-dirs "$DATA_DIR/game/validate" \
+  --game-model-input-dir "$OUT_DIR/model/best" \
+  --output-dir "$OUT_DIR/scores" \
+  --evaluators AUC
+
+echo
+echo "Outputs:"
+find "$OUT_DIR" -maxdepth 3 -name '*.json' | sed 's/^/  /'

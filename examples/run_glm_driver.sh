@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# End-to-end GLM pipeline demo (the analog of the reference's
+# examples/run_photon_ml_driver.sh, without the spark-submit ceremony):
+# generate data -> train a lambda-grid with warm starts -> validate ->
+# select best -> write text + Avro models + diagnostics report.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA_DIR="${DATA_DIR:-example-data}"
+OUT_DIR="${OUT_DIR:-example-out/glm}"
+
+[ -d "$DATA_DIR/glm/train" ] || python examples/generate_example_data.py --data-dir "$DATA_DIR"
+rm -rf "$OUT_DIR"
+
+python -m photon_ml_tpu.cli.glm_driver \
+  --training-data-directory "$DATA_DIR/glm/train" \
+  --validating-data-directory "$DATA_DIR/glm/validate" \
+  --output-directory "$OUT_DIR" \
+  --task LOGISTIC_REGRESSION \
+  --format AVRO \
+  --max-num-iterations 80 \
+  --regularization-weights 100,10,1,0.1 \
+  --regularization-type L2 \
+  --optimizer LBFGS \
+  --normalization-type STANDARDIZATION \
+  --diagnostic-mode VALIDATE \
+  --compute-variance true
+
+echo
+echo "Outputs in $OUT_DIR:"
+find "$OUT_DIR" -maxdepth 2 | sed 's/^/  /'
+echo
+echo "Best-model coefficients (name\tterm\tcoefficient\tlambda):"
+head -5 "$OUT_DIR/best-model/model.txt"
