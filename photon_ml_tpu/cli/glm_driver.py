@@ -90,6 +90,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=[t.value for t in NormalizationType])
     p.add_argument("--coefficient-box-constraints", default=None,
                    help="JSON constraint string (GLMSuite format)")
+    p.add_argument("--selected-features-file", default=None,
+                   help="Avro file of name/term records restricting the "
+                        "feature set (GLMSuite selectedFeaturesFile)")
+    p.add_argument("--summarization-output-dir", default=None,
+                   help="write per-feature statistics as "
+                        "FeatureSummarizationResultAvro here "
+                        "(ml/Driver.scala summarizeFeatures)")
     p.add_argument("--validate-data", default="VALIDATE_FULL",
                    choices=[t.value for t in DataValidationType])
     p.add_argument("--diagnostic-mode", default="NONE",
@@ -108,17 +115,61 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _read_selected_features(path: str) -> set:
+    """Selected-feature keys from an Avro file of name/term records
+    (GLMSuite.getSelectedFeatureSetFromFile, io/GLMSuite.scala:133-150)."""
+    from photon_ml_tpu.data.index_map import feature_key
+    from photon_ml_tpu.io.avro_codec import read_container
+
+    return {feature_key(r["name"], r.get("term") or "")
+            for r in read_container(path)}
+
+
+def _write_feature_summary(out_dir: Path, summary, imap) -> None:
+    """Per-feature statistics as FeatureSummarizationResultAvro
+    (util/IOUtils.scala:270-330: max/min/mean/normL1/normL2/numNonzeros/
+    variance keyed by feature name+term)."""
+    from photon_ml_tpu.data.index_map import split_key
+
+    records = []
+    for i in range(len(summary.mean)):
+        key = imap.get_feature_name(i) or str(i)
+        name, term = split_key(key)
+        records.append({
+            "featureName": name,
+            "featureTerm": term or None,
+            "metrics": {
+                "max": float(summary.max[i]),
+                "min": float(summary.min[i]),
+                "mean": float(summary.mean[i]),
+                "normL1": float(summary.norm_l1[i]),
+                "normL2": float(summary.norm_l2[i]),
+                "numNonzeros": float(summary.num_nonzeros[i]),
+                "variance": float(summary.variance[i]),
+            },
+        })
+    out_dir.mkdir(parents=True, exist_ok=True)
+    write_container(out_dir / "part-00000.avro",
+                    schemas.FEATURE_SUMMARIZATION_RESULT, records)
+
+
 def _load(path: str, fmt: str, add_intercept: bool, task: TaskType,
           index_map: IndexMap | None = None,
-          num_raw_features: int | None = None):
+          num_raw_features: int | None = None,
+          selected_features: set | None = None):
     """index_map / num_raw_features: pass the training map (AVRO) or the
     training feature width before intercept (LIBSVM) when loading validation
     data, so columns decode identically (the reference shares one feature
     index across splits)."""
     if fmt == "AVRO":
         mat, y, off, w, _, imap = read_labeled_points(
-            path, index_map=index_map, add_intercept=add_intercept)
+            path, index_map=index_map, add_intercept=add_intercept,
+            selected_features=selected_features)
         return mat, y, off, w, imap
+    if selected_features is not None:
+        raise ValueError(
+            "--selected-features-file requires --format AVRO "
+            "(LIBSVM features have no name/term keys)")
     files = sorted(Path(path).glob("*")) if Path(path).is_dir() else \
         [Path(path)]
     mats, ys = [], []
@@ -266,14 +317,23 @@ def run(argv=None) -> dict:
 
     # ---- preprocess ------------------------------------------------------
     with timer.time("preprocess"):
+        selected = (_read_selected_features(args.selected_features_file)
+                    if args.selected_features_file else None)
         mat, y, off, w, imap = _load(
-            args.training_data_directory, args.format, add_intercept, task)
+            args.training_data_directory, args.format, add_intercept, task,
+            selected_features=selected)
         logger.info("loaded %d rows x %d features", *mat.shape)
         validate_data(task, mat, y, off, w,
                       DataValidationType(args.validate_data))
         norm = None
-        if args.normalization_type != "NONE":
+        if args.normalization_type != "NONE" or args.summarization_output_dir:
             summary = BasicStatisticalSummary.compute(mat)
+            if args.summarization_output_dir:
+                _write_feature_summary(
+                    Path(args.summarization_output_dir), summary, imap)
+                logger.info("feature statistics written to %s",
+                            args.summarization_output_dir)
+        if args.normalization_type != "NONE":
             norm = build_normalization_context(
                 args.normalization_type, summary,
                 intercept_id=imap.intercept_index)

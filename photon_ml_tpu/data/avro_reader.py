@@ -45,13 +45,18 @@ def iter_records(path) -> Iterator[dict]:
         yield from read_container(f)
 
 
-def build_index_map(path, add_intercept: bool = True) -> IndexMap:
+def build_index_map(path, add_intercept: bool = True,
+                    selected_features: Optional[set] = None) -> IndexMap:
     """Scan pass collecting distinct (name, term) keys — the analog of
-    DefaultIndexMap generation / FeatureIndexingJob."""
+    DefaultIndexMap generation / FeatureIndexingJob. ``selected_features``
+    restricts the map to a whitelist of keys (the reference's
+    createDefaultIndexMapLoader(avroRDD, selectedFeatures))."""
     keys = set()
     for rec in iter_records(path):
         for f in rec["features"]:
-            keys.add(feature_key(f["name"], f.get("term") or ""))
+            key = feature_key(f["name"], f.get("term") or "")
+            if selected_features is None or key in selected_features:
+                keys.add(key)
     return IndexMap.from_keys(keys, add_intercept=add_intercept)
 
 
@@ -69,7 +74,8 @@ def read_labeled_points(
     (GLMSuite selected-features filtering).
     """
     if index_map is None:
-        index_map = build_index_map(path, add_intercept=add_intercept)
+        index_map = build_index_map(path, add_intercept=add_intercept,
+                                    selected_features=selected_features)
     intercept_idx = index_map.intercept_index if add_intercept else -1
 
     labels, offsets, weights, uids = [], [], [], []

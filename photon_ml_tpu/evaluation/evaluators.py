@@ -56,6 +56,10 @@ METRIC_METADATA = {
                        (0.0, 1.0)),
         MetricMetadata("F1", "harmonic mean of precision and recall", True,
                        (0.0, 1.0)),
+        MetricMetadata("PR_AUC", "area under the precision/recall curve",
+                       True, (0.0, 1.0)),
+        MetricMetadata("PEAK_F1", "max F1 over score thresholds", True,
+                       (0.0, 1.0)),
         MetricMetadata("LOG_LIKELIHOOD", "data log-likelihood", True),
         MetricMetadata("AIC", "Akaike information criterion", False),
         MetricMetadata("RMSE", "root mean squared error", False),
@@ -150,6 +154,54 @@ def area_under_roc_curve(scores, labels, weights=None) -> float:
     r[order] = ranks
     u = (w[pos] * r[pos]).sum() - w_pos * w_pos / 2.0
     return float(u / (w_pos * w_neg))
+
+
+def _pr_curve(scores, labels, weights=None):
+    """Weighted precision/recall points at each distinct-score threshold,
+    ordered by increasing recall (MLlib BinaryClassificationMetrics
+    convention: the curve is prepended with (0, p_first))."""
+    scores = _as_np(scores)
+    labels = _as_np(labels)
+    w = np.ones(len(scores)) if weights is None else _as_np(weights)
+    pos = (labels >= 0.5).astype(np.float64)
+    total_pos = (w * pos).sum()
+    if total_pos == 0:
+        return None
+    order = np.argsort(-scores, kind="mergesort")
+    s = scores[order]
+    tp = np.cumsum(w[order] * pos[order])
+    pred = np.cumsum(w[order])
+    # Collapse tie blocks: keep the LAST index of each distinct score.
+    last = np.r_[s[1:] != s[:-1], True]
+    tp, pred = tp[last], pred[last]
+    precision = tp / pred
+    recall = tp / total_pos
+    return precision, recall
+
+
+def area_under_precision_recall(scores, labels, weights=None) -> float:
+    """Weighted PR-AUC (trapezoidal; curve starts at (0, p_first) like
+    MLlib areaUnderPR — reference metric AREA_UNDER_PRECISION_RECALL,
+    ml/Evaluation.scala:81)."""
+    curve = _pr_curve(scores, labels, weights)
+    if curve is None:
+        return float("nan")
+    precision, recall = curve
+    p = np.r_[precision[0], precision]
+    r = np.r_[0.0, recall]
+    return float(np.trapezoid(p, r))
+
+
+def peak_f1_score(scores, labels, weights=None) -> float:
+    """Max F1 over score thresholds (reference PEAK_F1_SCORE,
+    ml/Evaluation.scala:83)."""
+    curve = _pr_curve(scores, labels, weights)
+    if curve is None:
+        return float("nan")
+    precision, recall = curve
+    denom = precision + recall
+    f1 = np.where(denom > 0, 2 * precision * recall / np.maximum(denom, 1e-300), 0.0)
+    return float(f1.max())
 
 
 @dataclasses.dataclass(frozen=True)

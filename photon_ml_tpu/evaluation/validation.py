@@ -8,7 +8,11 @@ from typing import Dict
 import numpy as np
 
 from photon_ml_tpu.constants import POSITIVE_RESPONSE_THRESHOLD
-from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve
+from photon_ml_tpu.evaluation.evaluators import (
+    area_under_precision_recall,
+    area_under_roc_curve,
+    peak_f1_score,
+)
 from photon_ml_tpu.types import TaskType
 
 
@@ -41,6 +45,8 @@ def evaluate_glm(task: TaskType, scores, labels, offsets=None, weights=None,
         recall = tp / (tp + fn) if tp + fn > 0 else 0.0
         out.update({
             "AUC": area_under_roc_curve(z, labels, weights),
+            "PR_AUC": area_under_precision_recall(z, labels, weights),
+            "PEAK_F1": peak_f1_score(z, labels, weights),
             "ACCURACY": float(np.average(pred == labels, weights=weights)),
             "PRECISION": precision,
             "RECALL": recall,
@@ -83,6 +89,8 @@ def evaluate_glm(task: TaskType, scores, labels, offsets=None, weights=None,
         pred = (z >= 0).astype(float)
         out.update({
             "AUC": area_under_roc_curve(z, labels, weights),
+            "PR_AUC": area_under_precision_recall(z, labels, weights),
+            "PEAK_F1": peak_f1_score(z, labels, weights),
             "ACCURACY": float(np.average(pred == labels, weights=weights)),
             "SMOOTHED_HINGE_LOSS": float(np.sum(weights * loss)),
         })

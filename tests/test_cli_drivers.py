@@ -283,3 +283,39 @@ def test_game_training_with_factored_random_effect(tmp_path, rng):
     np.testing.assert_allclose(
         score_summary["metrics"]["AUC"],
         summary["validationHistory"][-1]["AUC"], atol=1e-6)
+
+
+def test_glm_driver_selected_features_and_summarization(tmp_path, rng):
+    """--selected-features-file restricts the index map to the whitelist
+    (GLMSuite.scala:76-150); --summarization-output-dir writes per-feature
+    FeatureSummarizationResultAvro (IOUtils.scala:270-330)."""
+    train = tmp_path / "train"
+    _write_glm_avro(train, rng, n=150)
+    # Whitelist only f0, f1 (FeatureNameTermAvro-shaped records).
+    sel = tmp_path / "selected.avro"
+    write_container(sel, schemas.NAME_TERM_VALUE,
+                    [{"name": "f0", "term": None, "value": 0.0},
+                     {"name": "f1", "term": None, "value": 0.0}])
+    out = tmp_path / "out"
+    summ = tmp_path / "feature-summary"
+    summary = glm_driver.run([
+        "--training-data-directory", str(train),
+        "--output-directory", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--regularization-weights", "1",
+        "--max-num-iterations", "10",
+        "--selected-features-file", str(sel),
+        "--summarization-output-dir", str(summ),
+        "--dtype", "float64",
+    ])
+    # 2 selected features + intercept.
+    index = json.loads((out / "feature-index.json").read_text())
+    assert len(index) == 3
+    recs = list(read_container(summ / "part-00000.avro"))
+    assert len(recs) == 3
+    by_name = {r["featureName"]: r["metrics"] for r in recs}
+    assert {"f0", "f1"} <= set(by_name)
+    m = by_name["f0"]
+    assert {"max", "min", "mean", "normL1", "normL2", "numNonzeros",
+            "variance"} == set(m)
+    assert m["numNonzeros"] > 0

@@ -86,3 +86,59 @@ def test_build_evaluator_specs():
     assert ev.k == 5 and ev.id_type == "docId"
     with pytest.raises(ValueError):
         build_evaluator("NDCG@3")
+
+
+def test_pr_auc_perfect_and_random():
+    from photon_ml_tpu.evaluation.evaluators import (
+        area_under_precision_recall,
+        peak_f1_score,
+    )
+
+    scores = np.asarray([0.9, 0.8, 0.2, 0.1])
+    labels = np.asarray([1.0, 1.0, 0.0, 0.0])
+    assert area_under_precision_recall(scores, labels) == pytest.approx(1.0)
+    assert peak_f1_score(scores, labels) == pytest.approx(1.0)
+    # all-negative labels -> undefined
+    assert np.isnan(area_under_precision_recall(scores, np.zeros(4)))
+
+
+def test_pr_auc_matches_bruteforce():
+    from photon_ml_tpu.evaluation.evaluators import (
+        area_under_precision_recall,
+        peak_f1_score,
+    )
+
+    rng = np.random.default_rng(5)
+    scores = rng.normal(size=200)
+    labels = (rng.random(200) < 1 / (1 + np.exp(-scores))).astype(float)
+    w = rng.random(200) + 0.5
+
+    # Brute force: P/R at every distinct threshold, trapezoid with the
+    # MLlib-style (0, p_first) start point.
+    ts = np.unique(scores)[::-1]
+    ps, rs = [], []
+    total_pos = w[labels == 1].sum()
+    for t in ts:
+        sel = scores >= t
+        tp = w[sel & (labels == 1)].sum()
+        ps.append(tp / w[sel].sum())
+        rs.append(tp / total_pos)
+    expected = np.trapezoid(np.r_[ps[0], ps], np.r_[0.0, rs])
+    got = area_under_precision_recall(scores, labels, w)
+    assert got == pytest.approx(expected, rel=1e-12)
+
+    f1s = [2 * p * r / (p + r) for p, r in zip(ps, rs) if p + r > 0]
+    assert peak_f1_score(scores, labels, w) == pytest.approx(max(f1s),
+                                                             rel=1e-12)
+
+
+def test_evaluate_glm_includes_pr_metrics():
+    from photon_ml_tpu.evaluation.validation import evaluate_glm
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    scores = rng.normal(size=100)
+    labels = (rng.random(100) < 0.5).astype(float)
+    m = evaluate_glm(TaskType.LOGISTIC_REGRESSION, scores, labels)
+    assert {"PR_AUC", "PEAK_F1"} <= set(m)
+    assert 0.0 <= m["PR_AUC"] <= 1.0 and 0.0 <= m["PEAK_F1"] <= 1.0
