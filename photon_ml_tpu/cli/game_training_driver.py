@@ -37,6 +37,7 @@ from photon_ml_tpu.optimization.config import (
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.utils.date_range import resolve_input_dirs
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
+from photon_ml_tpu.utils.profiling import maybe_trace
 
 
 def _parse_named(values, what):
@@ -98,6 +99,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-types", default=None,
                    help="extra entity id columns to read from metadataMap "
                         "(defaults to the random-effect types)")
+    p.add_argument("--profile-output-dir", default=None,
+                   help="write a jax.profiler trace of training here "
+                        "(view with XProf/TensorBoard)")
     p.add_argument("--save-all-models", default="false",
                    choices=["true", "false"],
                    help="model-output-mode ALL vs BEST")
@@ -222,11 +226,12 @@ def run(argv=None) -> dict:
         task_type=task, coordinate_specs=specs,
         num_iterations=args.num_iterations,
         validation_evaluators=evaluators)
-    results = estimator.fit(
-        data, validation_data=validation,
-        checkpoint_dir=(Path(args.checkpoint_dir)
-                        if args.checkpoint_dir else None),
-        checkpoint_interval=args.checkpoint_interval)
+    with maybe_trace(args.profile_output_dir):
+        results = estimator.fit(
+            data, validation_data=validation,
+            checkpoint_dir=(Path(args.checkpoint_dir)
+                            if args.checkpoint_dir else None),
+            checkpoint_interval=args.checkpoint_interval)
     best_configs, best_result = estimator.select_best(results)
 
     save_game_model(

@@ -59,6 +59,7 @@ from photon_ml_tpu.utils import (
 )
 from photon_ml_tpu.utils.events import EventEmitter
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
+from photon_ml_tpu.utils.profiling import maybe_trace
 from photon_ml_tpu.utils.timer import PhaseTimer
 
 STAGES = ["INIT", "PREPROCESSED", "TRAINED", "VALIDATED", "DIAGNOSED"]
@@ -112,6 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated listener class paths")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "float64"])
+    p.add_argument("--profile-output-dir", default=None,
+                   help="write a jax.profiler trace of the train phase here "
+                        "(view with XProf/TensorBoard)")
     return p
 
 
@@ -350,7 +354,7 @@ def run(argv=None) -> dict:
     reg_ctx = RegularizationContext(
         RegularizationType(args.regularization_type),
         args.elastic_net_alpha)
-    with timer.time("train"):
+    with timer.time("train"), maybe_trace(args.profile_output_dir):
         trained = train_glm_models(
             mat, y, task,
             regularization_weights=lambdas,

@@ -1,5 +1,9 @@
 """Device-mesh parallelism: sharding specs and distributed training helpers."""
 
+from photon_ml_tpu.parallel.multihost import (
+    initialize_multihost,
+    is_primary_host,
+)
 from photon_ml_tpu.parallel.distributed import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -24,4 +28,6 @@ __all__ = [
     "shard_block",
     "shard_coef",
     "unpad_coef",
+    "initialize_multihost",
+    "is_primary_host",
 ]

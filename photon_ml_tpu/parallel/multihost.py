@@ -1,0 +1,76 @@
+"""Multi-host (multi-slice) runtime initialization.
+
+The reference's cross-machine substrate is Spark's driver/executor runtime
+(SparkContextConfiguration.scala, netty shuffle + TorrentBroadcast). The TPU
+counterpart is JAX's single-controller-per-host distributed runtime: every
+host calls :func:`initialize_multihost` once before any jax computation, then
+`jax.devices()` spans the whole pod/slice — ICI collectives cross chips
+within a slice and DCN carries cross-slice traffic, with XLA choosing the
+transport per mesh axis.
+
+Recipe for a multi-host GAME run (each host runs the same program):
+
+    from photon_ml_tpu.parallel import initialize_multihost, make_mesh
+    initialize_multihost()                 # no-op on a single host
+    mesh = make_mesh()                     # all devices, all hosts
+    ...build coordinates with mesh=mesh; CoordinateDescent.run(...)
+
+Data loading stays per-host: each host ingests its shard of rows and
+device_puts to its local addressable devices; `jax.make_array_from_*`
+assembles the global sharded arrays.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed when running under a multi-host launcher.
+
+    Arguments default from the standard env (JAX's own autodetection covers
+    Cloud TPU pods; COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID cover
+    manual launches). Returns True if distributed mode was initialized,
+    False for ordinary single-host runs (safe no-op — nothing to do).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+
+    if coordinator_address is None:
+        # No coordinator configured: single-host run, nothing to do. (On a
+        # Cloud TPU pod where full autodetection is wanted, call
+        # jax.distributed.initialize() with no arguments directly.)
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global "
+        "devices", jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count())
+    return True
+
+
+def is_primary_host() -> bool:
+    """True on the host that should own writes (model output, checkpoints,
+    logs) — the analog of the Spark driver's role."""
+    import jax
+
+    return jax.process_index() == 0

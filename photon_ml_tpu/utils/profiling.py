@@ -1,0 +1,18 @@
+"""Profiler integration (SURVEY §5: the reference relies on the Spark UI;
+the TPU build's counterpart is jax.profiler traces viewable in
+XProf/TensorBoard, plus the per-phase wall timers in utils/timer.py)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+
+def maybe_trace(trace_dir: Optional[str]):
+    """Context manager: a jax.profiler trace written to ``trace_dir`` when
+    set, a no-op otherwise. Drivers wrap their train phase with this."""
+    if not trace_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(str(trace_dir))

@@ -319,3 +319,28 @@ def test_glm_driver_selected_features_and_summarization(tmp_path, rng):
     assert {"max", "min", "mean", "normL1", "normL2", "numNonzeros",
             "variance"} == set(m)
     assert m["numNonzeros"] > 0
+
+
+def test_glm_driver_profile_trace(tmp_path, rng):
+    """--profile-output-dir writes a jax.profiler trace of the train phase."""
+    train = tmp_path / "train"
+    _write_glm_avro(train, rng, n=60)
+    out = tmp_path / "out"
+    prof = tmp_path / "profile"
+    glm_driver.run([
+        "--training-data-directory", str(train),
+        "--output-directory", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--regularization-weights", "1",
+        "--max-num-iterations", "5",
+        "--profile-output-dir", str(prof),
+        "--dtype", "float64",
+    ])
+    assert any(prof.rglob("*.xplane.pb")) or any(prof.iterdir())
+
+
+def test_multihost_initialize_noop_single_host():
+    from photon_ml_tpu.parallel import initialize_multihost, is_primary_host
+
+    assert initialize_multihost() is False  # no coordinator env -> no-op
+    assert is_primary_host() is True
