@@ -21,6 +21,7 @@ import io
 import json
 import os
 import struct
+import sys
 import zlib
 from typing import Any, BinaryIO, Dict, Iterable, Iterator, List, Optional
 
@@ -246,6 +247,116 @@ def read_datum(src: io.BytesIO, schema: Any) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# Native fast path: schema -> flat int64 program for the C decoder
+# (photon_ml_tpu/native/_avro_native.c). Falls back to read_datum when the
+# extension is unavailable or the schema uses something unsupported.
+# ---------------------------------------------------------------------------
+
+_PRIMITIVE_OPS = {"null": 0, "boolean": 1, "int": 2, "long": 2,
+                  "float": 3, "double": 4, "bytes": 5, "string": 6}
+
+
+class _SchemaProgram:
+    def __init__(self, prog, root: int, strings: tuple):
+        self.prog = prog.tobytes()  # int64 array buffer
+        self.root = root
+        self.strings = strings
+
+
+def compile_schema_program(schema: Any) -> Optional[_SchemaProgram]:
+    """Flatten a resolved schema into the C decoder's opcode array.
+    Returns None for shapes the native decoder doesn't handle (recursive
+    records) — callers then use the pure-python path."""
+    from array import array
+
+    prog = array("q")
+    strings: List[str] = []
+    string_ids: Dict[str, int] = {}
+    in_progress: set = set()
+
+    def intern(s: str) -> int:
+        if s not in string_ids:
+            string_ids[s] = len(strings)
+            strings.append(sys.intern(s))
+        return string_ids[s]
+
+    def emit(node: Any) -> Optional[int]:
+        t = node if isinstance(node, str) else (
+            node.get("type") if isinstance(node, dict) else None)
+        if isinstance(node, list):
+            children = [emit(b) for b in node]
+            if any(c is None for c in children):
+                return None
+            idx = len(prog)
+            prog.append(9)
+            prog.append(len(children))
+            prog.extend(children)
+            return idx
+        if isinstance(t, str) and t in _PRIMITIVE_OPS and (
+                isinstance(node, str) or set(node) <= {"type", "logicalType",
+                                                       "name", "namespace"}):
+            idx = len(prog)
+            prog.append(_PRIMITIVE_OPS[t])
+            return idx
+        if not isinstance(node, dict):
+            return None
+        if t == "fixed":
+            idx = len(prog)
+            prog.extend([7, int(node["size"])])
+            return idx
+        if t == "enum":
+            syms = [intern(s) for s in node["symbols"]]
+            idx = len(prog)
+            prog.extend([8, len(syms)])
+            prog.extend(syms)
+            return idx
+        if t == "array":
+            child = emit(node["items"])
+            if child is None:
+                return None
+            idx = len(prog)
+            prog.extend([10, child])
+            return idx
+        if t == "map":
+            child = emit(node["values"])
+            if child is None:
+                return None
+            idx = len(prog)
+            prog.extend([11, child])
+            return idx
+        if t == "record":
+            key = id(node)
+            if key in in_progress:
+                return None  # recursive schema: native path unsupported
+            in_progress.add(key)
+            fields = []
+            for f in node["fields"]:
+                child = emit(f["type"])
+                if child is None:
+                    in_progress.discard(key)
+                    return None
+                fields.append((intern(f["name"]), child))
+            in_progress.discard(key)
+            idx = len(prog)
+            prog.extend([12, len(fields)])
+            for name_id, child in fields:
+                prog.extend([name_id, child])
+            return idx
+        return None
+
+    root = emit(schema)
+    if root is None:
+        return None
+    return _SchemaProgram(prog, root, tuple(strings))
+
+
+def _native_decoder():
+    from photon_ml_tpu.native import load_avro_native
+
+    return load_avro_native()
+
+
+# ---------------------------------------------------------------------------
 # Object container files
 # ---------------------------------------------------------------------------
 
@@ -310,6 +421,8 @@ def read_container(path: str | os.PathLike) -> Iterator[Any]:
         if codec not in ("null", "deflate"):
             raise ValueError(f"unsupported codec {codec!r}")
         sync = f.read(16)
+        native = _native_decoder()
+        program = compile_schema_program(schema.root) if native else None
         while True:
             first = f.read(1)
             if not first:
@@ -320,9 +433,14 @@ def read_container(path: str | os.PathLike) -> Iterator[Any]:
             payload = f.read(size)
             if codec == "deflate":
                 payload = zlib.decompress(payload, -15)
-            src = io.BytesIO(payload)
-            for _ in range(count):
-                yield read_datum(src, schema.root)
+            if program is not None:
+                yield from native.decode_block(
+                    payload, count, program.prog, program.root,
+                    program.strings)
+            else:
+                src = io.BytesIO(payload)
+                for _ in range(count):
+                    yield read_datum(src, schema.root)
             if f.read(16) != sync:
                 raise ValueError(f"{path}: sync marker mismatch")
 

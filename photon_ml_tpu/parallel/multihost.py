@@ -11,9 +11,14 @@ transport per mesh axis.
 Recipe for a multi-host GAME run (each host runs the same program):
 
     from photon_ml_tpu.parallel import initialize_multihost, make_mesh
-    initialize_multihost()                 # no-op on a single host
+    initialize_multihost(auto=True)        # pods: jax autodetection;
+                                           # manual: COORDINATOR_ADDRESS env
     mesh = make_mesh()                     # all devices, all hosts
     ...build coordinates with mesh=mesh; CoordinateDescent.run(...)
+
+Without ``auto`` and without a coordinator address the call is a no-op and
+the process stays single-host — callers that REQUIRE multi-host must check
+the return value.
 
 Data loading stays per-host: each host ingests its shard of rows and
 device_puts to its local addressable devices; `jax.make_array_from_*`
@@ -33,13 +38,20 @@ def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    auto: bool = False,
 ) -> bool:
     """Initialize jax.distributed when running under a multi-host launcher.
 
-    Arguments default from the standard env (JAX's own autodetection covers
-    Cloud TPU pods; COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID cover
-    manual launches). Returns True if distributed mode was initialized,
-    False for ordinary single-host runs (safe no-op — nothing to do).
+    Two modes:
+    - explicit: a coordinator address via argument or COORDINATOR_ADDRESS /
+      NUM_PROCESSES / PROCESS_ID env (manual launches);
+    - ``auto=True``: delegate entirely to jax.distributed.initialize()'s
+      own cluster autodetection (Cloud TPU pods, SLURM, ...).
+
+    Returns True if distributed mode was initialized, False only when
+    neither mode applies (ordinary single-host run — a safe no-op, but a
+    multi-host deployment that reaches this has misconfigured its launcher,
+    so callers requiring multi-host must check the result).
     """
     import jax
 
@@ -51,9 +63,12 @@ def initialize_multihost(
         process_id = int(os.environ["PROCESS_ID"])
 
     if coordinator_address is None:
-        # No coordinator configured: single-host run, nothing to do. (On a
-        # Cloud TPU pod where full autodetection is wanted, call
-        # jax.distributed.initialize() with no arguments directly.)
+        if auto:
+            jax.distributed.initialize()
+            logger.info(
+                "jax.distributed autodetected: process %d/%d",
+                jax.process_index(), jax.process_count())
+            return True
         return False
 
     jax.distributed.initialize(
