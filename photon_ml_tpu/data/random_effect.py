@@ -198,6 +198,22 @@ def pearson_correlation_scores(
     return corr
 
 
+def filter_features_by_support(
+    x: sp.csr_matrix, min_num_support: int,
+    intercept_col: Optional[int] = None,
+) -> np.ndarray:
+    """Column indices observed (nonzero) in at least ``min_num_support``
+    rows — mirrors LocalDataSet.filterFeaturesBySupport
+    (ml/data/LocalDataSet.scala:93-114; an API the reference exposes but
+    never wires into its pipeline — same status here). The intercept column
+    always survives."""
+    support = np.diff(x.tocsc().indptr)
+    keep = support >= min_num_support
+    if intercept_col is not None and 0 <= intercept_col < x.shape[1]:
+        keep[intercept_col] = True
+    return np.flatnonzero(keep)
+
+
 def _next_size(v: int, minimum: int) -> int:
     """Smallest power of two >= max(v, minimum) — the bucket size classes."""
     v = max(v, minimum)

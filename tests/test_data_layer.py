@@ -218,3 +218,23 @@ def test_re_config_parse():
     assert c2.num_active_data_points is None
     with pytest.raises(ValueError):
         RandomEffectDataConfiguration.parse("tooFew,fields")
+
+
+def test_filter_features_by_support():
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.random_effect import filter_features_by_support
+
+    x = sp.csr_matrix(np.array([
+        [1.0, 0.0, 2.0, 1.0],
+        [0.0, 0.0, 3.0, 1.0],
+        [4.0, 0.0, 0.0, 1.0],
+    ]))
+    # support per column: [2, 0, 2, 3]
+    np.testing.assert_array_equal(
+        filter_features_by_support(x, 2), [0, 2, 3])
+    np.testing.assert_array_equal(
+        filter_features_by_support(x, 3), [3])
+    # intercept column always survives
+    np.testing.assert_array_equal(
+        filter_features_by_support(x, 5, intercept_col=3), [3])
