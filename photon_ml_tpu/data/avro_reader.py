@@ -51,6 +51,15 @@ def build_index_map(path, add_intercept: bool = True,
     DefaultIndexMap generation / FeatureIndexingJob. ``selected_features``
     restricts the map to a whitelist of keys (the reference's
     createDefaultIndexMapLoader(avroRDD, selectedFeatures))."""
+    from photon_ml_tpu.data.fast_ingest import fast_ingest
+
+    fast = fast_ingest(_avro_paths(path), {}, {}, collect_keys=True)
+    if fast is not None:
+        keys = fast.collected_keys
+        if selected_features is not None:
+            keys &= selected_features
+        return IndexMap.from_keys(keys, add_intercept=add_intercept)
+
     keys = set()
     for rec in iter_records(path):
         for f in rec["features"]:
@@ -77,6 +86,19 @@ def read_labeled_points(
         index_map = build_index_map(path, add_intercept=add_intercept,
                                     selected_features=selected_features)
     intercept_idx = index_map.intercept_index if add_intercept else -1
+
+    from photon_ml_tpu.data.fast_ingest import fast_ingest
+
+    fast = fast_ingest(
+        _avro_paths(path), {"m": index_map}, {"m": intercept_idx},
+        restrict_keys=selected_features)
+    if fast is not None:
+        data_, idx_, indptr_ = fast.shards["m"]
+        mat = sp.csr_matrix((data_, idx_, indptr_),
+                            shape=(len(fast.labels), len(index_map)))
+        mat.sum_duplicates()
+        return (mat, fast.labels, fast.offsets, fast.weights, fast.uids,
+                index_map)
 
     labels, offsets, weights, uids = [], [], [], []
     data, indices, indptr = [], [], [0]
@@ -125,6 +147,33 @@ def read_game_dataset(
     if feature_shard_maps is None:
         feature_shard_maps = {
             default_shard: build_index_map(path, add_intercept=add_intercept)}
+
+    from photon_ml_tpu.data.fast_ingest import fast_ingest
+
+    fast = fast_ingest(
+        _avro_paths(path), feature_shard_maps,
+        {s: (m.intercept_index if add_intercept else -1)
+         for s, m in feature_shard_maps.items()},
+        id_types=id_types)
+    if fast is not None:
+        n = len(fast.labels)
+        shards = {}
+        for shard, imap in feature_shard_maps.items():
+            data_, idx_, indptr_ = fast.shards[shard]
+            m = sp.csr_matrix((data_, idx_, indptr_),
+                              shape=(n, len(imap)))
+            m.sum_duplicates()
+            shards[shard] = m
+        data = GameDataset.build(
+            responses=fast.labels,
+            feature_shards=shards,
+            ids=fast.ids,
+            offsets=fast.offsets,
+            weights=fast.weights,
+            uids=np.asarray([u if u is not None else ""
+                             for u in fast.uids]),
+        )
+        return data, feature_shard_maps
 
     shard_builders = {
         s: {"data": [], "indices": [], "indptr": [0]}

@@ -65,6 +65,11 @@ class IndexMap:
     def key_items(self) -> Iterator[Tuple[str, int]]:
         return iter(self._k2i.items())
 
+    def key_to_index_dict(self) -> Dict[str, int]:
+        """The underlying key->index dict (NOT a copy) — handed to the
+        native ingest so feature lookups happen in C. Treat as read-only."""
+        return self._k2i
+
     @property
     def intercept_index(self) -> int:
         idx = self.get_index(INTERCEPT_KEY)

@@ -411,16 +411,41 @@ def write_container(
         flush()
 
 
+def _read_header(f, path):
+    if f.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an Avro container file")
+    meta = read_datum(f, _META_SCHEMA)  # type: ignore[arg-type]
+    schema = Schema(json.loads(meta["avro.schema"].decode()))
+    codec = meta.get("avro.codec", b"null").decode()
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported codec {codec!r}")
+    sync = f.read(16)
+    return schema, codec, sync
+
+
+def iter_raw_blocks(path: str | os.PathLike):
+    """Yield (schema, decompressed_payload, record_count) per block — the
+    entry point for block-level native decoders (data/fast_ingest.py)."""
+    with open(path, "rb") as f:
+        schema, codec, sync = _read_header(f, path)
+        while True:
+            first = f.read(1)
+            if not first:
+                return
+            f.seek(-1, 1)
+            count = _read_long(f)  # type: ignore[arg-type]
+            size = _read_long(f)  # type: ignore[arg-type]
+            payload = f.read(size)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            yield schema, payload, count
+            if f.read(16) != sync:
+                raise ValueError(f"{path}: sync marker mismatch")
+
+
 def read_container(path: str | os.PathLike) -> Iterator[Any]:
     with open(path, "rb") as f:
-        if f.read(4) != MAGIC:
-            raise ValueError(f"{path}: not an Avro container file")
-        meta = read_datum(f, _META_SCHEMA)  # type: ignore[arg-type]
-        schema = Schema(json.loads(meta["avro.schema"].decode()))
-        codec = meta.get("avro.codec", b"null").decode()
-        if codec not in ("null", "deflate"):
-            raise ValueError(f"unsupported codec {codec!r}")
-        sync = f.read(16)
+        schema, codec, sync = _read_header(f, path)
         native = _native_decoder()
         program = compile_schema_program(schema.root) if native else None
         while True:
