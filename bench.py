@@ -104,7 +104,7 @@ def main():
         return
 
     data = build_problem()
-    per_iter, objective = run_cd(data, num_iterations=3)
+    per_iter, objective = run_cd(data, num_iterations=10)
 
     baseline_s = None
     try:

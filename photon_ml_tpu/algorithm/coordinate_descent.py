@@ -16,7 +16,6 @@ per-coordinate "addScoresToOffsets" shuffle is a gather.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -62,6 +61,50 @@ class CoordinateDescent:
         self.task_type = task_type
         self.validation_data = validation_data
         self.validation_evaluators = list(validation_evaluators)
+        self._fused_fns = None
+
+    def _fused_update_fns(self):
+        """One jitted function per coordinate performing the ENTIRE update —
+        residual reduce, solve (all buckets), re-score, full objective — as a
+        single device dispatch. On a remote chip the eager sequence cost
+        ~5-6 dispatches x tunnel latency per update; fused it costs one.
+
+        Data pytrees are passed as ARGUMENTS (not trace constants) so the
+        compiled executables reference buffers, and params of every
+        coordinate flow in so the objective's penalty terms evaluate
+        on-device with no model materialization."""
+        if self._fused_fns is not None:
+            return self._fused_fns
+        loss = loss_for_task(self.task_type)
+        names = list(self.coordinates)
+
+        def make(n):
+            coord = self.coordinates[n]
+
+            def fused(data, pdata_all, params_all, other_scores, base_key,
+                      step, rows):
+                residual = None
+                for s in other_scores:
+                    residual = s if residual is None else residual + s
+                key = jax.random.fold_in(base_key, step)
+                new_p, tracker = coord.pure_update(
+                    data, params_all[n], residual, key)
+                score = coord.pure_score(data, new_p)
+                total = score if residual is None else residual + score
+                labels, offsets, weights = rows
+                obj = jnp.sum(weights * loss.loss(total + offsets, labels))
+                for m in names:
+                    pm = new_p if m == n else params_all[m]
+                    for c, l1, l2 in self.coordinates[m].pure_penalties(
+                            pm, pdata_all[m]):
+                        obj = obj + 0.5 * l2 * jnp.sum(jnp.square(c))
+                        obj = obj + l1 * jnp.sum(jnp.abs(c))
+                return new_p, score, obj, tracker
+
+            return jax.jit(fused)
+
+        self._fused_fns = {n: make(n) for n in names}
+        return self._fused_fns
 
     def run(
         self,
@@ -83,7 +126,6 @@ class CoordinateDescent:
         if checkpoint_interval < 1:
             raise ValueError(
                 f"checkpoint_interval must be >= 1, got {checkpoint_interval}")
-        loss = loss_for_task(self.task_type)
         names = list(self.coordinates)
 
         if initial_model is None:
@@ -103,9 +145,8 @@ class CoordinateDescent:
                 "taskType": self.task_type.value, "tag": checkpoint_tag}
 
         def _save(step):
-            # Materialize IN PLACE so each device scalar is transferred
-            # exactly once across the run, not once per checkpoint.
-            objective_history[:] = _as_floats(objective_history)
+            _sync_models()
+            _materialize_history()
             ckpt.save_checkpoint(checkpoint_dir, ckpt.CheckpointState(
                 step=step, models=models,
                 objective_history=list(objective_history),
@@ -137,8 +178,44 @@ class CoordinateDescent:
                                            self.task_type)
                 logger.info("resumed from %s (step %d)", latest, done_steps)
 
+        # The fused path: params/scores dicts are the authoritative training
+        # state on device; model objects are materialized lazily (checkpoint,
+        # validation, return) so the hot loop is exactly ONE dispatch per
+        # coordinate update.
+        data_args = {n: self.coordinates[n].step_data() for n in names}
+        pdata_args = {n: self.coordinates[n].penalty_data() for n in names}
+        params = {n: self.coordinates[n].params_of(models[n]) for n in names}
+        fused = self._fused_update_fns()
+
+        def _sync_models():
+            for m in names:
+                models[m] = self.coordinates[m].model_of(params[m], models[m])
+
         scores: Dict[str, Array] = {
-            n: self.coordinates[n].score(models[n]) for n in names}
+            n: self.coordinates[n].pure_score(data_args[n], params[n])
+            for n in names}
+        rows = self._training_rows(next(iter(scores.values())).dtype)
+
+        # Objective history lives in a FIXED-CAPACITY device vector updated
+        # by a tiny jitted set (enqueue-only); materialization is ONE
+        # device->host transfer. Per-entry float() syncs cost a full tunnel
+        # round trip each (~65-85ms measured on the remote-TPU backend) and
+        # dominated whole runs. Capacity is padded to a power of two so the
+        # updater executable is shared across runs of different lengths.
+        total_steps = max(num_iterations * len(names),
+                          len(objective_history))
+        cap = max(64, 1 << max(0, total_steps - 1).bit_length())
+        hist_dtype = np.dtype(next(iter(scores.values())).dtype)
+        hist_host = np.zeros(cap, hist_dtype)
+        hist_host[:len(objective_history)] = [
+            float(v) for v in objective_history]
+        hist_dev = jnp.asarray(hist_host)
+        hist_len = len(objective_history)
+        del objective_history[:]  # device vector is now authoritative
+
+        def _materialize_history():
+            objective_history[:] = [
+                float(v) for v in np.asarray(hist_dev)[:hist_len]]
 
         validating = (self.validation_data is not None
                       and bool(self.validation_evaluators))
@@ -148,36 +225,32 @@ class CoordinateDescent:
                 step += 1
                 if step <= done_steps:
                     continue  # resumed past this update
-                coord = self.coordinates[n]
                 t0 = time.perf_counter()
-                # Deterministic per-step key: resume-invariant, unlike
-                # sequential splitting.
-                sub = jax.random.fold_in(base_key, step)
-                # Single coordinate: residual is None (no other scores) —
-                # mirrors CoordinateDescent.scala's descend-only-one branch.
-                # The residual is reduced FRESH from the other coordinates'
-                # scores every step (the reference's partial-score reduce,
-                # CoordinateDescent.scala:150-158) rather than kept as a
-                # running total: identical models then take an identical
-                # arithmetic path, which is what makes a resumed run match
-                # an uninterrupted one bit-for-bit in f32.
-                residual = _residual_of_others(scores, names, n)
-                models[n], tracker = coord.update_model(
-                    models[n], residual, sub)
+                # One dispatch: residual reduce (the reference's
+                # partial-score reduce, CoordinateDescent.scala:150-158,
+                # recomputed FRESH each step so a resumed run matches an
+                # uninterrupted one bit-for-bit), per-step fold_in key,
+                # solve, re-score, full objective incl. every coordinate's
+                # penalties. The step index is passed as a device scalar so
+                # the compiled executable is reused across steps.
+                new_p, new_score, obj, tracker = fused[n](
+                    data_args[n], pdata_args, params,
+                    tuple(scores[m] for m in names if m != n),
+                    base_key, np.uint32(step), rows)
+                params[n] = new_p
+                scores[n] = new_score
+                if isinstance(tracker, tuple):
+                    tracker = list(tracker)
                 trackers[n].append(tracker)
-                scores[n] = coord.score(models[n])
-                total = (scores[n] if residual is None
-                         else residual + scores[n])
                 timings[n] += time.perf_counter() - t0
 
-                # Device scalar — NOT synced here. A float() per coordinate
-                # update costs a full host<->device round trip; histories are
-                # materialized at checkpoint/return instead.
-                obj = self._training_objective(loss, total, models)
-                objective_history.append(obj)
-                if logger.isEnabledFor(logging.INFO):
-                    logger.info("iter %d coordinate %s: objective=%.6f", it,
-                                n, float(obj))
+                # Device-side history write — NOT synced here (a float()
+                # per update costs a full tunnel round trip); materialized
+                # in one transfer at checkpoint/return.
+                hist_dev = _hist_set(hist_dev, np.uint32(step - 1), obj)
+                hist_len = max(hist_len, step)
+                logger.info("iter %d coordinate %s updated (%.1f ms)", it,
+                            n, 1e3 * (time.perf_counter() - t0))
                 # Defer the last-coordinate save to after validation: one
                 # save per iteration boundary, and a crash during validation
                 # resumes from before the final update, so the re-run never
@@ -191,6 +264,7 @@ class CoordinateDescent:
             if step <= done_steps:
                 continue  # whole iteration was restored, incl. validation
             if validating:
+                _sync_models()
                 game_model = GameModel(dict(models), self.task_type)
                 val_scores = game_model.score(self.validation_data)
                 metrics = {
@@ -208,29 +282,23 @@ class CoordinateDescent:
                     # validation entry + best model.
                     _save(step)
 
+        _sync_models()
+        _materialize_history()
+        if logger.isEnabledFor(logging.INFO) and objective_history:
+            logger.info("objective history: %s",
+                        ["%.6f" % v for v in objective_history])
         final = GameModel(dict(models), self.task_type)
         if best_model is None:
             best_model = final
         return CoordinateDescentResult(
             model=final,
-            objective_history=_as_floats(objective_history),
+            objective_history=list(objective_history),
             validation_history=validation_history,
             best_model=best_model,
             best_metric=best_metric,
             trackers=trackers,
             timings=timings,
         )
-
-    def _training_objective(self, loss, total_scores: Array, models):
-        """Full training objective as a DEVICE scalar (one jitted dispatch,
-        no host sync) — the eager version cost several host<->device round
-        trips per coordinate update on a remote chip."""
-        labels, offsets, weights = self._training_rows(total_scores.dtype)
-        penalties = tuple(
-            tuple(self.coordinates[n].penalties(models[n]))
-            for n in self.coordinates)
-        return _objective_impl(loss, total_scores, labels, offsets,
-                               weights, penalties)
 
     def _training_rows(self, dtype) -> Tuple[Array, Array, Array]:
         """(labels, offsets, weights) aligned with the global row order,
@@ -253,38 +321,10 @@ class CoordinateDescent:
         return rows
 
 
-def _residual_of_others(scores: Dict[str, Array], names: Sequence[str],
-                        current: str) -> Optional[Array]:
-    others = [scores[m] for m in names if m != current]
-    if not others:
-        return None
-    if len(others) == 1:
-        return others[0]
-    return jnp.sum(jnp.stack(others), axis=0)
-
-
-def _as_floats(history) -> List[float]:
-    """Materialize a history of (device-scalar | float) objective values with
-    one batched transfer rather than one sync per entry."""
-    if not history:
-        return []
-    arrs = [v for v in history if isinstance(v, jax.Array)]
-    if arrs:
-        jax.block_until_ready(arrs[-1])
-    return [float(v) for v in history]
-
-
-@functools.partial(jax.jit, static_argnames=("loss",))
-def _objective_impl(loss, total_scores, labels, offsets, weights, penalties):
-    """Full coordinate-descent objective: weighted loss on total scores plus
-    every coordinate's penalty (CoordinateDescent.scala:203-212).
-    ``penalties`` is a nested tuple of (coefs, l1, l2) device triples."""
-    out = jnp.sum(weights * loss.loss(total_scores + offsets, labels))
-    for coord_penalties in penalties:
-        for c, l1, l2 in coord_penalties:
-            out = out + 0.5 * l2 * jnp.sum(jnp.square(c))
-            out = out + l1 * jnp.sum(jnp.abs(c))
-    return out
+@jax.jit
+def _hist_set(hist, idx, value):
+    """Write one objective value into the device-resident history vector."""
+    return hist.at[idx].set(value.astype(hist.dtype))
 
 
 def _rows_from_blocks(ds) -> Tuple[Array, Array, Array]:

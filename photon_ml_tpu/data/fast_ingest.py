@@ -217,10 +217,14 @@ def fast_ingest(
                     payload, count, layout.prog, layout.layout,
                     dicts_t, icepts_t, ids_t, DELIMITER, keys)
             label_chunks.append(np.frombuffer(lb, np.float64))
-            if layout.has_offset:
-                off_chunks.append(np.frombuffer(ob, np.float64))
-            if layout.has_weight:
-                w_chunks.append(np.frombuffer(wb, np.float64))
+            # Always append a chunk per block so files with and without
+            # optional fields can be mixed without misaligning rows.
+            off_chunks.append(np.frombuffer(ob, np.float64)
+                              if layout.has_offset
+                              else np.zeros(count))
+            w_chunks.append(np.frombuffer(wb, np.float64)
+                            if layout.has_weight
+                            else np.ones(count))
             if layout.has_uid:
                 uids.extend(us)
             else:

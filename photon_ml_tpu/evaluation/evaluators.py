@@ -189,7 +189,9 @@ def area_under_precision_recall(scores, labels, weights=None) -> float:
     precision, recall = curve
     p = np.r_[precision[0], precision]
     r = np.r_[0.0, recall]
-    return float(np.trapezoid(p, r))
+    # np.trapezoid is NumPy >= 2.0; np.trapz is its pre-2.0 name.
+    trapezoid = getattr(np, "trapezoid", None) or np.trapz
+    return float(trapezoid(p, r))
 
 
 def peak_f1_score(scores, labels, weights=None) -> float:

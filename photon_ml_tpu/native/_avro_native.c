@@ -518,12 +518,15 @@ static PyObject *py_decode_training_block(PyObject *self, PyObject *args) {
                                     goto done;
                             } else if (gk == 11) {
                                 /* term: union[null,string] (aux = null
-                                 * branch) or plain string (aux = -1) */
+                                 * branch) or plain string (aux = -1).
+                                 * A plain string has no branch tag and is
+                                 * always present, so it must always be
+                                 * consumed. */
                                 int64_t br = -1;
                                 if (ga >= 0 &&
                                     read_long_raw(&st, &br) < 0)
                                     goto done;
-                                if (br != ga
+                                if ((ga < 0 || br != ga)
                                     && read_str_span(&st, &term_p,
                                                      &term_l) < 0)
                                     goto done;
@@ -605,11 +608,25 @@ static PyObject *py_decode_training_block(PyObject *self, PyObject *args) {
                                 PyObject *v = PyUnicode_FromStringAndSize(
                                     vp, vlv);
                                 if (!v) goto done;
-                                int rc = PyList_Append(
-                                    PyTuple_GET_ITEM(ids_out, w), v);
-                                Py_DECREF(v);
-                                if (rc < 0) goto done;
-                                ids_seen_mask |= (1 << w);
+                                PyObject *lst =
+                                    PyTuple_GET_ITEM(ids_out, w);
+                                if (ids_seen_mask & (1 << w)) {
+                                    /* duplicate map key in this record:
+                                     * keep the last occurrence (matches
+                                     * the pure-python dict semantics)
+                                     * instead of appending twice and
+                                     * shifting row alignment */
+                                    if (PyList_SetItem(
+                                            lst,
+                                            PyList_GET_SIZE(lst) - 1,
+                                            v) < 0)
+                                        goto done;
+                                } else {
+                                    int rc = PyList_Append(lst, v);
+                                    Py_DECREF(v);
+                                    if (rc < 0) goto done;
+                                    ids_seen_mask |= (1 << w);
+                                }
                             }
                         }
                     }
@@ -624,9 +641,14 @@ static PyObject *py_decode_training_block(PyObject *self, PyObject *args) {
         }
 
         if (n_ids && ids_seen_mask != (1 << n_ids) - 1) {
-            PyErr_SetString(PyExc_ValueError,
-                            "record is missing a requested id type in "
-                            "metadataMap");
+            /* mirror the pure-python error surface
+             * (data/avro_reader.py): name the first absent id type */
+            Py_ssize_t miss = 0;
+            while (miss < n_ids && (ids_seen_mask & (1 << miss)))
+                miss++;
+            PyErr_Format(PyExc_ValueError,
+                         "record is missing id type %R in metadataMap",
+                         PyTuple_GET_ITEM(want_ids, miss));
             goto done;
         }
         for (Py_ssize_t s = 0; s < n_shards; s++) {

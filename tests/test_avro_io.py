@@ -36,6 +36,21 @@ from photon_ml_tpu.models import (
 from photon_ml_tpu.types import TaskType
 
 
+@pytest.fixture(params=["native", "python"])
+def ingest_mode(request, monkeypatch):
+    """Run ingest tests through BOTH the native C fast path and the
+    pure-python fallback so their behavior (values AND error surfaces)
+    cannot drift apart."""
+    import photon_ml_tpu.native as nat
+
+    if request.param == "python":
+        monkeypatch.setattr(nat, "_loaded", True)
+        monkeypatch.setattr(nat, "_module", None)
+    elif nat.load_avro_native() is None:
+        pytest.skip("no C compiler available for the native decoder")
+    return request.param
+
+
 def _examples():
     return [
         {"uid": "r1", "label": 1.0,
@@ -74,7 +89,7 @@ def test_container_multi_block(tmp_path):
     assert back[4321]["label"] == 4321.0
 
 
-def test_read_labeled_points(tmp_path):
+def test_read_labeled_points(tmp_path, ingest_mode):
     p = tmp_path / "train.avro"
     write_container(p, schemas.TRAINING_EXAMPLE, _examples())
     mat, y, off, w, uids, imap = read_labeled_points(p)
@@ -89,7 +104,7 @@ def test_read_labeled_points(tmp_path):
     np.testing.assert_allclose(mat.toarray()[:, imap.intercept_index], 1.0)
 
 
-def test_read_game_dataset(tmp_path):
+def test_read_game_dataset(tmp_path, ingest_mode):
     p = tmp_path / "game.avro"
     write_container(p, schemas.TRAINING_EXAMPLE, _examples())
     data, shard_maps = read_game_dataset(p, id_types=["userId", "itemId"])

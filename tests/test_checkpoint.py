@@ -61,27 +61,29 @@ def test_resume_matches_uninterrupted_run(rng, tmp_path):
                                TaskType.LOGISTIC_REGRESSION)
     ref = cd_ref.run(num_iterations=3, seed=11)
 
-    # Fault-injected run: crash during iteration 2 (step 3 of 6).
+    # Fault-injected run: crash during iteration 2 (step 4 of 6). The hot
+    # loop runs through fused jitted update fns, so the fault is injected
+    # at the dispatch layer (the jit cache means a fault inside pure_update
+    # would only fire while tracing).
     coords = build_coordinates(data)
-    crashing = coords["perUser"]
-    original_update = crashing.update_model
+    cd_crash = CoordinateDescent(coords, TaskType.LOGISTIC_REGRESSION)
+    fns = cd_crash._fused_update_fns()
+    original_update = fns["perUser"]
     calls = {"n": 0}
 
-    def failing_update(model, residual, key):
+    def failing_update(*args):
         calls["n"] += 1
         if calls["n"] == 2:  # second perUser update = step 4
             raise RuntimeError("injected fault")
-        return original_update(model, residual, key)
+        return original_update(*args)
 
-    crashing.update_model = failing_update
-    cd_crash = CoordinateDescent(coords, TaskType.LOGISTIC_REGRESSION)
+    fns["perUser"] = failing_update
     with pytest.raises(RuntimeError, match="injected fault"):
         cd_crash.run(num_iterations=3, seed=11, checkpoint_dir=tmp_path)
     # Steps 1..3 completed and were checkpointed before the crash.
     assert max(all_checkpoint_steps(tmp_path)) == 3
 
     # Fresh process-equivalent: new coordinates, resume from disk.
-    crashing.update_model = original_update
     cd_resume = CoordinateDescent(build_coordinates(data),
                                   TaskType.LOGISTIC_REGRESSION)
     resumed = cd_resume.run(num_iterations=3, seed=11,
