@@ -45,6 +45,28 @@ def iter_records(path) -> Iterator[dict]:
         yield from read_container(f)
 
 
+def _record_label(rec: dict) -> float:
+    """Label under either field-name set: 'label' (TrainingExampleFieldNames)
+    or 'response' (ResponsePredictionFieldNames) — the reference's two Avro
+    input formats (ml/avro/TrainingExampleFieldNames.scala,
+    ResponsePredictionFieldNames.scala, io/FieldNamesType.scala:22).
+    Auto-detected per record instead of a --format-type flag."""
+    v = rec.get("label")
+    if v is None:
+        v = rec.get("response")
+    if v is None:
+        raise ValueError(
+            "record has neither a 'label' nor a 'response' field")
+    return float(v)
+
+
+def _record_features(rec: dict) -> Iterable[dict]:
+    """Feature list, tolerating union-null arrays/entries (Pig-generated
+    schemas wrap everything in [null, X] — e.g. the reference's
+    poisson_test.avro fixture)."""
+    return (f for f in (rec.get("features") or ()) if f is not None)
+
+
 def build_index_map(path, add_intercept: bool = True,
                     selected_features: Optional[set] = None) -> IndexMap:
     """Scan pass collecting distinct (name, term) keys — the analog of
@@ -62,7 +84,7 @@ def build_index_map(path, add_intercept: bool = True,
 
     keys = set()
     for rec in iter_records(path):
-        for f in rec["features"]:
+        for f in _record_features(rec):
             key = feature_key(f["name"], f.get("term") or "")
             if selected_features is None or key in selected_features:
                 keys.add(key)
@@ -103,12 +125,12 @@ def read_labeled_points(
     labels, offsets, weights, uids = [], [], [], []
     data, indices, indptr = [], [], [0]
     for rec in iter_records(path):
-        labels.append(float(rec["label"]))
+        labels.append(_record_label(rec))
         offsets.append(float(rec.get("offset") or 0.0))
         w = rec.get("weight")
         weights.append(1.0 if w is None else float(w))
         uids.append(rec.get("uid"))
-        for f in rec["features"]:
+        for f in _record_features(rec):
             key = feature_key(f["name"], f.get("term") or "")
             if selected_features is not None and key not in selected_features:
                 continue
@@ -182,7 +204,7 @@ def read_game_dataset(
     ids: Dict[str, list] = {t: [] for t in id_types}
 
     for rec in iter_records(path):
-        labels.append(float(rec["label"]))
+        labels.append(_record_label(rec))
         offsets.append(float(rec.get("offset") or 0.0))
         w = rec.get("weight")
         weights.append(1.0 if w is None else float(w))
@@ -196,7 +218,7 @@ def read_game_dataset(
             ids[t].append(str(v))
         for shard, imap in feature_shard_maps.items():
             b = shard_builders[shard]
-            for f in rec["features"]:
+            for f in _record_features(rec):
                 idx = imap.get_index(feature_key(f["name"],
                                                  f.get("term") or ""))
                 if idx >= 0:

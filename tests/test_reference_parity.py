@@ -1,0 +1,280 @@
+"""Golden-value parity against the reference's OWN integration fixtures.
+
+The reference encodes its expected behavior in
+photon-ml/src/integTest/resources/DriverIntegTest/input/ and asserts on it
+in DriverTest.scala (shape/stage/λ-grid/best-model expectations, constants
+at DriverTest.scala:944-945) and supervised/*Validator.scala (prediction
+finiteness, non-negativity for Poisson, AUC thresholds —
+BinaryClassifierAUCValidator.scala, BaseGLMTest.scala:226-231). These tests
+read the reference's checked-in fixtures AS-IS and hold this implementation
+to the same bars, so semantic drift from the reference fails loudly.
+
+(The GAME yahoo-music train/test fixtures are NOT present in the reference
+checkout — only a 6-row duplicateFeatures sample — so the GAME RMSE bars
+from cli/game/training/DriverTest.scala:53,130,202 cannot be reproduced
+here; the GLM fixtures below are complete.)
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.avro_reader import build_index_map, read_labeled_points
+from photon_ml_tpu.data.index_map import feature_key
+from photon_ml_tpu.data.libsvm import read_libsvm
+from photon_ml_tpu.evaluation.evaluators import area_under_roc_curve
+
+REF_INPUT = Path(
+    "/root/reference/photon-ml/src/integTest/resources/DriverIntegTest/input")
+
+pytestmark = pytest.mark.skipif(
+    not REF_INPUT.exists(), reason="reference fixtures not available")
+
+# DriverTest.scala:944-945
+EXPECTED_NUM_FEATURES = 14
+EXPECTED_NUM_TRAINING_DATA = 250
+
+
+def _train_glm(mat, y, task, lam=10.0, max_iter=80, tol=1e-6,
+               optimizer="LBFGS"):
+    """Train one GLM the way the reference driver does for one λ
+    (ModelTraining.scala:102-214 semantics; reference defaults λ=10,
+    L-BFGS, maxIter 80, tol 1e-6 per ml/Params.scala:42-203)."""
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.ops import GLMObjective
+    from photon_ml_tpu.ops.features import DenseFeatures
+    from photon_ml_tpu.ops.glm_objective import make_batch
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.optimization.solver import solve_glm
+
+    dense = np.asarray(mat.todense() if sp.issparse(mat) else mat)
+    batch = make_batch(DenseFeatures(jnp.asarray(dense)), jnp.asarray(y))
+    config = GLMOptimizationConfiguration(
+        max_iterations=max_iter, tolerance=tol,
+        regularization_weight=lam,
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        optimizer_type=OptimizerType(optimizer))
+    objective = GLMObjective(loss_for_task(task))
+    result = solve_glm(objective, batch, config,
+                       jnp.zeros(dense.shape[1], jnp.float64))
+    return np.asarray(result.x), result
+
+
+# ---------------------------------------------------------------------------
+# heart.avro — the central DriverTest fixture
+# ---------------------------------------------------------------------------
+
+def test_heart_avro_shape_and_labels():
+    """DriverTest expects 250 rows x 14 features (13 + intercept) and binary
+    labels (DataValidators logistic checks)."""
+    mat, y, off, w, uids, imap = read_labeled_points(REF_INPUT / "heart.avro")
+    assert mat.shape == (EXPECTED_NUM_TRAINING_DATA, EXPECTED_NUM_FEATURES)
+    assert len(imap) == EXPECTED_NUM_FEATURES
+    assert set(np.unique(y)) <= {0.0, 1.0}
+    np.testing.assert_array_equal(w, 1.0)
+    np.testing.assert_array_equal(off, 0.0)
+    # Without intercept: the 13 original heart features, like the
+    # reference's addIntercept=false runs (expectedNumFeatures = 13).
+    mat13, *_ = read_labeled_points(REF_INPUT / "heart.avro",
+                                    add_intercept=False)
+    assert mat13.shape == (250, 13)
+
+
+def test_heart_logistic_quality():
+    """Train with reference defaults (λ=10, L-BFGS) on heart.avro; hold the
+    model to the reference's validator bars (finite predictions, working
+    classifier AUC) AND to the optimum of the identical objective found by
+    an independent solver (scipy L-BFGS-B) — the strongest semantic-parity
+    check available without a JVM: same convex objective, same optimum."""
+    import scipy.optimize as so
+
+    from photon_ml_tpu.types import TaskType
+
+    mat, y, *_ = read_labeled_points(REF_INPUT / "heart.avro")
+    # Tight tolerance so the comparison is optimum-vs-optimum (reference
+    # defaults stop at |Δf| <= 1e-6·f0, slightly short of the minimizer).
+    coef, result = _train_glm(mat, y, TaskType.LOGISTIC_REGRESSION,
+                              max_iter=500, tol=1e-12)
+    assert np.all(np.isfinite(coef))
+
+    # Independent solve of Σ log1pexp semantics + λ/2‖w‖² (the reference's
+    # LogisticLossFunction + L2Regularization, glm/LogisticLossFunction.scala
+    # + L2Regularization.scala).
+    dense = np.asarray(mat.todense())
+
+    def nll(w):
+        z = dense @ w
+        return float(np.sum(np.logaddexp(0, z) - y * z) + 5.0 * (w @ w))
+
+    ref = so.minimize(nll, np.zeros(dense.shape[1]), method="L-BFGS-B",
+                      options={"maxiter": 500, "ftol": 1e-14})
+    assert float(result.value) <= ref.fun * (1 + 1e-5)
+    np.testing.assert_allclose(coef, ref.x, rtol=1e-3, atol=1e-4)
+
+    auc_train = area_under_roc_curve(mat @ coef, y)
+    assert 0.85 <= auc_train <= 1.0, auc_train
+
+    # heart_validation is only 20 rows (96 label pairs) — assert the same
+    # AUC an exact solver of this objective achieves (0.74), with slack.
+    vmat, vy, *_ = read_labeled_points(
+        REF_INPUT / "heart_validation.avro",
+        index_map=build_index_map(REF_INPUT / "heart.avro"))
+    auc_val = area_under_roc_curve(vmat @ coef, vy)
+    assert 0.70 <= auc_val <= 1.0, auc_val
+
+
+def test_heart_avro_vs_libsvm_identical_model():
+    """heart.txt is the SAME dataset in LibSVM form (DriverTest's
+    testLibSVMRunWithValidation trains on it with feature-dimension 13).
+    Reading both formats and training with the same config must give the
+    same coefficients — cross-format ingest parity."""
+    from photon_ml_tpu.types import TaskType
+
+    mat_a, y_a, *_rest = read_labeled_points(REF_INPUT / "heart.avro")
+    imap = _rest[-1]
+    mat_l, y_l = read_libsvm(REF_INPUT / "heart.txt", num_features=13)
+
+    # Align columns: avro column order comes from the IndexMap; libsvm
+    # column j holds feature "j+1" and the intercept is last.
+    perm = [imap.get_index(feature_key(str(j + 1))) for j in range(13)]
+    perm.append(imap.intercept_index)
+    mat_a_aligned = np.asarray(mat_a.todense())[:, perm]
+
+    np.testing.assert_array_equal(y_a, y_l)
+    np.testing.assert_allclose(mat_a_aligned, np.asarray(mat_l.todense()),
+                               rtol=1e-12)
+
+    c_avro, _ = _train_glm(mat_a_aligned, y_a, TaskType.LOGISTIC_REGRESSION)
+    c_lsvm, _ = _train_glm(np.asarray(mat_l.todense()), y_l,
+                           TaskType.LOGISTIC_REGRESSION)
+    np.testing.assert_allclose(c_avro, c_lsvm, rtol=1e-6, atol=1e-8)
+
+
+def test_heart_driver_end_to_end(tmp_path):
+    """The full GLM driver on the reference fixture, mirroring DriverTest's
+    testRunWithDataValidation: default grid [10], LBFGS, stages through
+    VALIDATED, one learned model per λ, best-model selected with λ=10
+    (DriverTest.scala:148-152)."""
+    from photon_ml_tpu.cli import glm_driver
+
+    out = tmp_path / "out"
+    glm_driver.run([
+        "--training-data-directory", str(REF_INPUT / "heart.avro"),
+        "--validating-data-directory",
+        str(REF_INPUT / "heart_validation.avro"),
+        "--output-directory", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--dtype", "float64",
+    ])
+    best = out / "best-model" / "model.txt"
+    assert best.exists()
+    # One model per λ in the default grid ("10") under all-models/<λ>/
+    # (the reference's LEARNED_MODELS_TEXT layout).
+    txts = sorted((out / "all-models").rglob("model.txt"))
+    assert len(txts) == 1
+    assert txts[0].parent.name == "10.0"
+    # Best model text carries λ=10 in its fourth column
+    # (the reference's model text format: name\tterm\tvalue\tlambda).
+    first = best.read_text().strip().splitlines()[0].split("\t")
+    assert float(first[3]) == 10.0
+
+
+# ---------------------------------------------------------------------------
+# linear_regression_train/val.avro — 1000 rows x 7 features
+# (DriverTest.testDiagnosticGenerationProvider, DriverTest.scala:786)
+# ---------------------------------------------------------------------------
+
+def test_linear_regression_fixture_quality():
+    from photon_ml_tpu.types import TaskType
+
+    mat, y, *_rest = read_labeled_points(
+        REF_INPUT / "linear_regression_train.avro")
+    imap = _rest[-1]
+    assert mat.shape == (1000, 7)  # 6 features + intercept
+
+    coef, _ = _train_glm(mat, y, TaskType.LINEAR_REGRESSION, lam=0.0)
+    pred = mat @ coef
+    # PredictionFiniteValidator + MaximumDifferenceValidator semantics
+    # (BaseGLMTest.scala:124-126; bound = 10 * inlier σ).
+    assert np.all(np.isfinite(pred))
+    resid = pred - y
+    assert np.abs(resid).max() <= 10 * y.std()
+    # The fit must explain the fixture far better than the mean predictor.
+    r2 = 1 - np.sum(resid ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.5, r2
+
+    vmat, vy, *_ = read_labeled_points(
+        REF_INPUT / "linear_regression_val.avro", index_map=imap)
+    vresid = vmat @ coef - vy
+    vr2 = 1 - np.sum(vresid ** 2) / np.sum((vy - vy.mean()) ** 2)
+    assert vr2 > 0.5, vr2
+
+
+# ---------------------------------------------------------------------------
+# poisson_test.avro — ResponsePredictionFieldNames format (Pig schema:
+# response/feature floats wrapped in [null, X] unions), 4521 rows x 27 cols
+# (DriverTest.scala:788 reads it with FieldNamesType.RESPONSE_PREDICTION)
+# ---------------------------------------------------------------------------
+
+def test_poisson_response_prediction_format():
+    from photon_ml_tpu.types import TaskType
+
+    mat, y, off, w, uids, imap = read_labeled_points(
+        REF_INPUT / "poisson_test.avro")
+    assert mat.shape[0] == 4521
+    assert mat.shape[1] == 27  # 26 features + intercept (DriverTest: 27)
+    assert np.all(y >= 0)  # DataValidators Poisson non-negative response
+
+    coef, _ = _train_glm(mat, y, TaskType.POISSON_REGRESSION, lam=10.0,
+                         max_iter=40)
+    # NonNegativePredictionValidator: Poisson mean = exp(margin) > 0, finite.
+    mean = np.exp(mat @ coef)
+    assert np.all(np.isfinite(mean))
+    assert np.all(mean >= 0)
+
+
+# ---------------------------------------------------------------------------
+# a9a (LibSVM) + logistic_regression_val.avro — the adult dataset pair
+# (32561 train / 16281 validation, 124 features incl. intercept,
+# DriverTest.scala:787)
+# ---------------------------------------------------------------------------
+
+def test_a9a_train_avro_validation():
+    from photon_ml_tpu.types import TaskType
+
+    mat, y = read_libsvm(REF_INPUT / "a9a", num_features=123)
+    assert mat.shape == (32561, 124)
+    assert set(np.unique(y)) == {0.0, 1.0}
+
+    coef, _ = _train_glm(mat, y, TaskType.LOGISTIC_REGRESSION, lam=10.0,
+                         max_iter=50)
+
+    # Validate against the reference's avro conversion of a9a.t: align
+    # avro columns (named "1".."123" + intercept) with libsvm order. The
+    # index map comes from the TRAIN feature space (the reference trains
+    # the map on training data; one indicator never fires in validation).
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    imap = IndexMap.from_name_terms(
+        [(str(j + 1), "") for j in range(123)], add_intercept=True)
+    vmat, vy, *_rest = read_labeled_points(
+        REF_INPUT / "logistic_regression_val.avro", index_map=imap)
+    assert vmat.shape == (16281, 124)
+    perm = [imap.get_index(feature_key(str(j + 1))) for j in range(123)]
+    perm.append(imap.intercept_index)
+    vdense = np.asarray(vmat.todense())[:, perm]
+
+    auc = area_under_roc_curve(vdense @ coef, vy)
+    # L2-regularized logistic on a9a reaches ~0.90 validation AUC; any
+    # semantic drift (loss, regularization, ingest alignment) falls well
+    # below this bar.
+    assert auc >= 0.88, auc
