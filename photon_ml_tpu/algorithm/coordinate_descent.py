@@ -62,6 +62,7 @@ class CoordinateDescent:
         self.validation_data = validation_data
         self.validation_evaluators = list(validation_evaluators)
         self._fused_fns = None
+        self._val_scorer = None
 
     def _fused_update_fns(self):
         """One jitted function per coordinate performing the ENTIRE update —
@@ -266,7 +267,18 @@ class CoordinateDescent:
             if validating:
                 _sync_models()
                 game_model = GameModel(dict(models), self.task_type)
-                val_scores = game_model.score(self.validation_data)
+                # Device-side scoring: the validation shards live in HBM
+                # (uploaded once at first use); per-iteration scoring is one
+                # jitted dispatch + ONE transfer of the score vector, vs the
+                # reference's per-submodel score joins
+                # (FixedEffectModel.scala:94-105, RandomEffectModel.scala).
+                if self._val_scorer is None:
+                    from photon_ml_tpu.models.device_scoring import (
+                        DeviceGameScorer,
+                    )
+                    self._val_scorer = DeviceGameScorer(
+                        game_model, self.validation_data, dtype=hist_dtype)
+                val_scores = np.asarray(self._val_scorer.score(game_model))
                 metrics = {
                     ev.name: ev.evaluate_dataset(val_scores,
                                                  self.validation_data)
