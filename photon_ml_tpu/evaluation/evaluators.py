@@ -137,19 +137,15 @@ def area_under_roc_curve(scores, labels, weights=None) -> float:
     order = np.argsort(scores, kind="mergesort")
     s = scores[order]
     ww = w[order]
-    # Midranks with ties, weighted: rank = cumw below + (tie block w + own)/2.
-    ranks = np.empty(len(s))
-    i = 0
-    cum = 0.0
-    while i < len(s):
-        j = i
-        while j < len(s) and s[j] == s[i]:
-            j += 1
-        block_w = ww[i:j].sum()
-        # Weighted midrank: cum-weight below the tie block + half the block.
-        ranks[i:j] = cum + block_w / 2.0
-        cum += block_w
-        i = j
+    # Weighted midranks with ties, vectorized: rank = cum-weight strictly
+    # below the tie block + half the block's weight (reduceat over tie-block
+    # starts replaces the per-block python loop).
+    cw_excl = np.cumsum(ww) - ww
+    new_block = np.r_[True, s[1:] != s[:-1]]
+    bstart = np.flatnonzero(new_block)
+    bid = np.cumsum(new_block) - 1
+    bw = np.add.reduceat(ww, bstart)
+    ranks = cw_excl[bstart][bid] + bw[bid] / 2.0
     r = np.empty(len(s))
     r[order] = ranks
     u = (w[pos] * r[pos]).sum() - w_pos * w_pos / 2.0
@@ -267,14 +263,76 @@ class SmoothedHingeLossEvaluator(Evaluator):
 
 
 class _ShardedEvaluator(Evaluator):
-    """Group rows by an id column; average the local metric over groups."""
+    """Group rows by an id column; average the local metric over groups.
+
+    Both sharded metrics are computed SORT-ONCE + segmented (np.lexsort +
+    reduceat over group/tie-block starts) — one pass for any number of
+    groups, replacing per-group python loops that dominated validation
+    wallclock at 5k-1M groups (reference per-group path:
+    ml/evaluation/ShardedAreaUnderROCCurveEvaluator.scala +
+    AreaUnderROCCurveLocalEvaluator.scala)."""
 
     id_type: str
 
-    def _groups(self, data):
-        from photon_ml_tpu.data.game_data import group_rows_by_code
+    def _codes(self, data) -> np.ndarray:
+        return data.id_columns[self.id_type].codes
 
-        return group_rows_by_code(data.id_columns[self.id_type].codes)
+
+def sharded_auc(pred, labels, weights, codes) -> float:
+    """Mean of per-group weighted AUCs (midrank ties), vectorized.
+
+    Groups with a single class are skipped, matching the per-group NaN
+    filter of the reference's sharded evaluator."""
+    order = np.lexsort((pred, codes))
+    g = np.asarray(codes)[order]
+    s = np.asarray(pred)[order]
+    w = np.asarray(weights, np.float64)[order]
+    pos = np.asarray(labels)[order] >= 0.5
+    if len(g) == 0:
+        return float("nan")
+
+    new_group = np.r_[True, g[1:] != g[:-1]]
+    gstart = np.flatnonzero(new_group)
+    gid = np.cumsum(new_group) - 1
+    # Within-group cum weight strictly below each row.
+    cw = np.cumsum(w)
+    cw_excl = cw - w
+    rel_excl = cw_excl - cw_excl[gstart][gid]
+    # Tie blocks: same group AND same score.
+    new_block = np.r_[True, (g[1:] != g[:-1]) | (s[1:] != s[:-1])]
+    bstart = np.flatnonzero(new_block)
+    bid = np.cumsum(new_block) - 1
+    bw = np.add.reduceat(w, bstart)
+    rank = rel_excl[bstart][bid] + bw[bid] / 2.0
+
+    w_pos = np.add.reduceat(np.where(pos, w, 0.0), gstart)
+    w_neg = np.add.reduceat(np.where(pos, 0.0, w), gstart)
+    u = np.add.reduceat(np.where(pos, w * rank, 0.0), gstart) \
+        - w_pos * w_pos / 2.0
+    valid = (w_pos > 0) & (w_neg > 0)
+    if not valid.any():
+        return float("nan")
+    return float(np.mean(u[valid] / (w_pos[valid] * w_neg[valid])))
+
+
+def sharded_precision_at_k(pred, labels, codes, k: int) -> float:
+    """Mean of per-group precision@k (stable descending score order),
+    vectorized: one lexsort + positional mask + segmented sums."""
+    pred = np.asarray(pred)
+    codes = np.asarray(codes)
+    order = np.lexsort((-pred, codes))
+    g = codes[order]
+    hit = (np.asarray(labels)[order] >= 0.5).astype(np.float64)
+    n = len(g)
+    if n == 0:
+        return float("nan")
+    new_group = np.r_[True, g[1:] != g[:-1]]
+    gstart = np.flatnonzero(new_group)
+    gid = np.cumsum(new_group) - 1
+    in_top = (np.arange(n) - gstart[gid]) < k
+    hits = np.add.reduceat(np.where(in_top, hit, 0.0), gstart)
+    sizes = np.diff(np.r_[gstart, n])
+    return float(np.mean(hits / np.minimum(k, sizes)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -293,12 +351,7 @@ class ShardedAreaUnderROCCurveEvaluator(_ShardedEvaluator):
     def _evaluate(self, pred, labels, weights, data) -> float:
         if data is None:
             raise ValueError("sharded evaluators need the dataset (id columns)")
-        vals = []
-        for rows in self._groups(data):
-            v = area_under_roc_curve(pred[rows], labels[rows], weights[rows])
-            if not np.isnan(v):
-                vals.append(v)
-        return float(np.mean(vals)) if vals else float("nan")
+        return sharded_auc(pred, labels, weights, self._codes(data))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -318,13 +371,7 @@ class ShardedPrecisionAtKEvaluator(_ShardedEvaluator):
     def _evaluate(self, pred, labels, weights, data) -> float:
         if data is None:
             raise ValueError("sharded evaluators need the dataset (id columns)")
-        vals = []
-        for rows in self._groups(data):
-            if len(rows) == 0:
-                continue
-            top = rows[np.argsort(-pred[rows], kind="stable")[: self.k]]
-            vals.append(float((labels[top] >= 0.5).mean()))
-        return float(np.mean(vals)) if vals else float("nan")
+        return sharded_precision_at_k(pred, labels, self._codes(data), self.k)
 
 
 _PLAIN = {
