@@ -1,16 +1,21 @@
-"""Benchmark: GAME coordinate-descent iteration throughput on the real chip.
+"""Benchmark: GAME coordinate-descent throughput on the real chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-The workload is the BASELINE.md north-star shape: GLMix (fixed effect +
-per-user random effects, logistic) — fixed-effect L-BFGS solve + vmapped
-per-entity solves + score exchange per coordinate-descent iteration.
+Workloads (BASELINE.json configs 4-5, the north-star shapes):
+- headline — GLMix: fixed effect (200k x 200, logistic) + per-user random
+  effects with REAL per-user features (5k users x 25 features), L-BFGS +
+  vmapped per-entity solves + score exchange per CD iteration.
+- extra.game_full_cd_iters_per_sec — full GAME: fixed + per-user RE +
+  per-item RE + a factored (matrix-factorization) per-item coordinate.
+- extra.fe_lbfgs_iter_ms — fixed-effect L-BFGS time per optimizer
+  iteration on the 200k x 200 solve (the config-1/2 inner-loop number).
 
 vs_baseline: speedup over the same training step executed with JAX on one
 host CPU core — the stand-in for the reference's Spark-local[*] CPU+BLAS
-execution (the reference publishes no numbers; BASELINE.md mandates
-self-measured baselines).
+execution (no JVM exists in this image, so the Spark wallclock itself is
+unmeasurable; this is JAX-on-CPU, not Spark).
 """
 
 from __future__ import annotations
@@ -23,8 +28,16 @@ import time
 
 import numpy as np
 
+N_ROWS = 200_000
+D_FIXED = 200
+N_USERS = 5_000
+D_USER = 25
+N_ITEMS = 2_000
+D_ITEM = 16
 
-def build_problem(seed=7, n=200_000, d=200, n_users=5_000):
+
+def build_problem(seed=7, n=N_ROWS, d=D_FIXED, n_users=N_USERS,
+                  d_user=D_USER, n_items=N_ITEMS, d_item=D_ITEM):
     import scipy.sparse as sp
 
     from photon_ml_tpu.data.game_data import GameDataset
@@ -34,22 +47,45 @@ def build_problem(seed=7, n=200_000, d=200, n_users=5_000):
     x[:, -1] = 1.0
     w = rng.normal(0, 0.5, d)
     users = rng.integers(0, n_users, n)
-    bias = rng.normal(0, 1.0, n_users)
-    z = x @ w + bias[users]
+    items = rng.integers(0, n_items, n)
+    # Real per-user features (intercept first) — the per-entity solves are
+    # d_user-dimensional, exercising the vmapped-L-BFGS kernel for real.
+    xu = rng.normal(0, 1, (n, d_user)).astype(np.float32)
+    xu[:, 0] = 1.0
+    xi = rng.normal(0, 1, (n, d_item)).astype(np.float32)
+    xi[:, 0] = 1.0
+    wu = rng.normal(0, 0.3, (n_users, d_user))
+    bias_i = rng.normal(0, 0.5, n_items)
+    z = x @ w + np.einsum("nd,nd->n", xu, wu[users]) + bias_i[items]
     y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float)
     return GameDataset.build(
         responses=y,
         feature_shards={"global": sp.csr_matrix(x),
-                        "user": sp.csr_matrix(np.ones((n, 1)))},
-        ids={"userId": users.astype(str)})
+                        "user": sp.csr_matrix(xu),
+                        "item": sp.csr_matrix(xi)},
+        ids={"userId": users.astype(str), "itemId": items.astype(str)})
 
 
-def run_cd(data, num_iterations):
-    """Returns (steady-state seconds per CD iteration, final objective)."""
-    import jax
+def _configs():
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+        RegularizationType,
+    )
 
+    l2 = RegularizationContext(RegularizationType.L2)
+    fe = GLMOptimizationConfiguration(
+        max_iterations=50, tolerance=1e-7, regularization_weight=1.0,
+        regularization_context=l2)
+    re = GLMOptimizationConfiguration(
+        max_iterations=20, tolerance=1e-6, regularization_weight=1.0,
+        regularization_context=l2)
+    return fe, re
+
+
+def build_coords(data, full_game=False):
     from photon_ml_tpu.algorithm import (
-        CoordinateDescent,
+        FactoredRandomEffectCoordinate,
         FixedEffectCoordinate,
         RandomEffectCoordinate,
     )
@@ -57,37 +93,79 @@ def run_cd(data, num_iterations):
         RandomEffectDataConfiguration,
         build_random_effect_dataset,
     )
-    from photon_ml_tpu.optimization.config import (
-        GLMOptimizationConfiguration,
-        RegularizationContext,
-        RegularizationType,
-    )
+    from photon_ml_tpu.optimization.config import MFOptimizationConfiguration
     from photon_ml_tpu.types import TaskType
 
-    re_data = build_random_effect_dataset(
-        data, RandomEffectDataConfiguration("userId", "user"),
-        intercept_col=0)
+    fe_cfg, re_cfg = _configs()
+    task = TaskType.LOGISTIC_REGRESSION
     coords = {
         "fixed": FixedEffectCoordinate(
             name="fixed", data=data, feature_shard_id="global",
-            task_type=TaskType.LOGISTIC_REGRESSION,
-            config=GLMOptimizationConfiguration(
-                max_iterations=50, tolerance=1e-7, regularization_weight=1.0,
-                regularization_context=RegularizationContext(RegularizationType.L2))),
+            task_type=task, config=fe_cfg),
         "perUser": RandomEffectCoordinate(
-            name="perUser", dataset=re_data,
-            task_type=TaskType.LOGISTIC_REGRESSION,
-            config=GLMOptimizationConfiguration(
-                max_iterations=20, tolerance=1e-6, regularization_weight=1.0,
-                regularization_context=RegularizationContext(RegularizationType.L2))),
+            name="perUser",
+            dataset=build_random_effect_dataset(
+                data, RandomEffectDataConfiguration("userId", "user"),
+                intercept_col=0),
+            task_type=task, config=re_cfg),
     }
-    cd = CoordinateDescent(coords, TaskType.LOGISTIC_REGRESSION)
-    # Warm-up iteration compiles everything.
-    cd.run(num_iterations=1)
+    if full_game:
+        coords["perItem"] = RandomEffectCoordinate(
+            name="perItem",
+            dataset=build_random_effect_dataset(
+                data, RandomEffectDataConfiguration("itemId", "item"),
+                intercept_col=0),
+            task_type=task, config=re_cfg)
+        coords["itemFactors"] = FactoredRandomEffectCoordinate(
+            name="itemFactors",
+            dataset=build_random_effect_dataset(
+                data, RandomEffectDataConfiguration(
+                    "itemId", "item", projector_type="IDENTITY"),
+                intercept_col=0),
+            task_type=task, config=re_cfg,
+            latent_config=re_cfg,
+            mf_config=MFOptimizationConfiguration(max_iterations=1,
+                                                  num_factors=4))
+    return coords
+
+
+def run_cd(data, num_iterations, full_game=False, warmup=1):
+    """Returns (steady-state seconds per CD iteration, final objective)."""
+    from photon_ml_tpu.algorithm import CoordinateDescent
+    from photon_ml_tpu.types import TaskType
+
+    cd = CoordinateDescent(build_coords(data, full_game=full_game),
+                           TaskType.LOGISTIC_REGRESSION)
+    cd.run(num_iterations=warmup)  # compiles everything
     t0 = time.perf_counter()
     res = cd.run(num_iterations=num_iterations)
     per_iter = (time.perf_counter() - t0) / num_iterations
     return per_iter, res.objective_history[-1]
+
+
+def fe_lbfgs_iter_ms(data):
+    """Fixed-effect L-BFGS wallclock per optimizer iteration (config 1/2:
+    the distributed value+gradient inner loop)."""
+    import jax
+
+    from photon_ml_tpu.algorithm import FixedEffectCoordinate
+    from photon_ml_tpu.types import TaskType
+
+    fe_cfg, _ = _configs()
+    coord = FixedEffectCoordinate(
+        name="fixed", data=data, feature_shard_id="global",
+        task_type=TaskType.LOGISTIC_REGRESSION, config=fe_cfg)
+    model = coord.initialize_model()
+    key = jax.random.PRNGKey(0)
+    model2, result = coord.update_model(model, None, key)
+    jax.block_until_ready(result.x)
+    float(result.value)  # true sync (block_until_ready alone can return
+    # before remote completion on the tunnel backend)
+    t0 = time.perf_counter()
+    _, result = coord.update_model(model, None, key)
+    iters = int(result.iterations)  # sync
+    dt = time.perf_counter() - t0
+    return 1e3 * dt / max(1, iters)
 
 
 def main():
@@ -105,6 +183,8 @@ def main():
 
     data = build_problem()
     per_iter, objective = run_cd(data, num_iterations=10)
+    full_per_iter, _ = run_cd(data, num_iterations=5, full_game=True)
+    fe_ms = fe_lbfgs_iter_ms(data)
 
     baseline_s = None
     try:
@@ -121,9 +201,18 @@ def main():
     result = {
         "metric": "game_glmix_cd_iters_per_sec",
         "value": round(1.0 / per_iter, 4),
-        "unit": "iters/sec (200k rows, d=200 fixed + 5k-user random effects)",
+        "unit": ("iters/sec (200k rows; d=200 fixed + 5k users x 25 "
+                 "random-effect features)"),
         "vs_baseline": (round(baseline_s / per_iter, 2)
                         if baseline_s else None),
+        "extra": {
+            "game_full_cd_iters_per_sec": round(1.0 / full_per_iter, 4),
+            "game_full_workload": ("fixed + per-user RE + per-item RE + "
+                                   "factored per-item (MF k=4)"),
+            "fe_lbfgs_iter_ms": round(fe_ms, 3),
+            "vs_baseline_note": "same JAX code on 1 host CPU (no JVM/Spark "
+                                "available to measure the reference itself)",
+        },
     }
     print(json.dumps(result))
 
