@@ -240,7 +240,9 @@ class RandomEffectCoordinate(Coordinate):
         self._l1, self._l2 = _l1_l2(self.config)
 
     def initialize_model(self) -> RandomEffectModel:
-        return RandomEffectModel.zeros_like_dataset(self.dataset)
+        dt = (self.dataset.blocks[0].x.dtype if self.dataset.blocks
+              else jnp.float32)
+        return RandomEffectModel.zeros_like_dataset(self.dataset, dtype=dt)
 
     def update_model(
         self, model: RandomEffectModel, residual_scores: Optional[Array],

@@ -234,6 +234,22 @@ def run(argv=None) -> dict:
             checkpoint_interval=args.checkpoint_interval)
     best_configs, best_result = estimator.select_best(results)
 
+    from photon_ml_tpu.models.tracking import summarize_trackers
+
+    # Aggregate per-entity optimizer telemetry (convergence-reason counts,
+    # iteration/objective stats per coordinate per update) — the
+    # operational summary the reference computes via RDD.stats() in
+    # ml/optimization/game/*Tracker.scala.
+    tracker_summary = summarize_trackers(best_result.trackers)
+    for name, per_update in tracker_summary.items():
+        if per_update:
+            last = per_update[-1]
+            logger.info(
+                "coordinate %s (last update): %d solves, reasons %s, "
+                "iterations mean %.1f max %d", name, last["numSolves"],
+                last["convergenceReasons"], last["iterations"]["mean"],
+                int(last["iterations"]["max"]))
+
     save_game_model(
         out_dir / "best", best_result.best_model, shard_maps,
         metadata_extras={
@@ -241,6 +257,7 @@ def run(argv=None) -> dict:
                 k: v.to_json() for k, v in best_configs.items()},
             "updatingSequence": sequence,
             "numIterations": args.num_iterations,
+            "optimizationTrackers": tracker_summary,
         })
     # Persist the feature index maps next to the model so the scoring driver
     # can decode features identically (the reference ships PalDB stores).

@@ -19,6 +19,7 @@ from photon_ml_tpu.models.tracking import (
     ModelTracker,
     OptimizerState,
     summarize_coefficients,
+    summarize_trackers,
 )
 
 __all__ = [
@@ -38,4 +39,5 @@ __all__ = [
     "ModelTracker",
     "OptimizerState",
     "summarize_coefficients",
+    "summarize_trackers",
 ]

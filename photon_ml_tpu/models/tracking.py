@@ -171,6 +171,55 @@ class CoefficientSummary:
         )
 
 
+def _reason_names(reasons: np.ndarray) -> dict:
+    vals, counts = np.unique(reasons, return_counts=True)
+    return {ConvergenceReason(int(v)).name: int(c)
+            for v, c in zip(vals, counts)}
+
+
+def _stats(a: np.ndarray) -> dict:
+    a = np.asarray(a, np.float64).ravel()
+    return {"mean": float(a.mean()), "min": float(a.min()),
+            "max": float(a.max())}
+
+
+def summarize_update_tracker(tracker) -> dict:
+    """Aggregate one coordinate update's OptimizerResult(s) — a single
+    result (fixed effect), or a list of vmapped per-bucket results whose
+    leaves carry one entry per entity (random effects) — into the
+    operational telemetry the reference surfaces per coordinate:
+    convergence-reason counts (RandomEffectOptimizationTracker.
+    countConvergenceReasons), iteration stats (getNumIterationStats) and
+    final-objective stats (FixedEffectOptimizationTracker via
+    RDD.stats())."""
+    results = tracker if isinstance(tracker, (list, tuple)) else [tracker]
+    reasons, iters, values = [], [], []
+    for r in results:
+        reasons.append(np.asarray(r.reason).ravel())
+        iters.append(np.asarray(r.iterations).ravel())
+        values.append(np.asarray(r.value).ravel())
+    reasons = np.concatenate(reasons)
+    iters = np.concatenate(iters)
+    values = np.concatenate(values)
+    return {
+        "numSolves": int(reasons.size),
+        "convergenceReasons": _reason_names(reasons),
+        "iterations": _stats(iters),
+        "finalValue": _stats(values),
+    }
+
+
+def summarize_trackers(trackers: dict) -> dict:
+    """coordinate name -> per-update aggregate summaries, JSON-ready.
+
+    The GAME analog of the reference's OptimizationTracker.toSummaryString
+    chain (ml/optimization/game/*Tracker.scala): per update, how many
+    entity solves ran, why they stopped, and the iteration/objective
+    distributions across entities."""
+    return {name: [summarize_update_tracker(t) for t in per_update]
+            for name, per_update in trackers.items()}
+
+
 def summarize_coefficients(
     models: Sequence[GeneralizedLinearModel],
 ) -> List[CoefficientSummary]:

@@ -20,7 +20,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# Second CI configuration (SURVEY hard-part 3): PHOTON_ML_TPU_TEST_F32=1
+# runs the suite WITHOUT x64 — every array stays f32, the dtype the real
+# TPU executes. tests/test_f32_parity.py asserts f32-vs-f64 agreement of
+# optimizer outcomes regardless of mode.
+_F32_MODE = os.environ.get("PHOTON_ML_TPU_TEST_F32") == "1"
+if not _F32_MODE:
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 # Plugins (flax/chex) may have imported jax before this conftest ran, in which
 # case the env vars above were read too late — re-apply through jax.config
@@ -28,7 +35,7 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_enable_x64", not _F32_MODE)
 
 import numpy as np
 import pytest
@@ -36,6 +43,39 @@ import pytest
 assert jax.device_count() == 8, (
     f"test harness expected 8 virtual CPU devices, got {jax.device_count()}"
 )
+
+F32_MODE = _F32_MODE
+
+# dtype-aware golden tolerances: f32 carries ~7 significant digits, so
+# equality/closed-form assertions that demand 1e-12 in the f64 config get
+# a calibrated bound in the f32 config instead of a false failure.
+GOLD_RTOL = 1e-5 if F32_MODE else 1e-12
+SOLVE_RTOL = 2e-3 if F32_MODE else 1e-5  # optimizer-vs-optimum agreement
+
+
+def gold(rtol: float, f32_floor: float = None) -> float:
+    """A test's f64-calibrated tolerance, floored at the f32 bound when the
+    suite runs in the PHOTON_ML_TPU_TEST_F32=1 config."""
+    if not F32_MODE:
+        return rtol
+    return max(rtol, f32_floor if f32_floor is not None else GOLD_RTOL)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "needs_f64: test depends on double precision (finite differences, "
+        "sub-1e-8 golden values) and is skipped in the f32 CI config")
+
+
+def pytest_collection_modifyitems(config, items):
+    if not F32_MODE:
+        return
+    skip = pytest.mark.skip(
+        reason="requires f64 (PHOTON_ML_TPU_TEST_F32=1 config)")
+    for item in items:
+        if "needs_f64" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture

@@ -1,0 +1,71 @@
+"""Worker program for the two-process multihost test (run via subprocess).
+
+Each process owns 2 virtual CPU devices; together they form a 4-device
+global mesh. Exercises initialize_multihost's explicit-coordinator path
+(the analog of a manual multi-host TPU launch) plus a cross-host psum.
+"""
+
+import os
+import sys
+
+
+def main():
+    # Per-process device config must land before jax initializes.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from photon_ml_tpu.parallel import initialize_multihost, is_primary_host
+
+    ok = initialize_multihost()  # COORDINATOR_ADDRESS etc. from env
+    assert ok, "initialize_multihost returned False under a launcher config"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert jax.local_device_count() == 2
+
+    pid = jax.process_index()
+    assert is_primary_host() == (pid == 0)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("data",))
+
+    # Global arange(8) sharded 2-per-device across BOTH processes; the
+    # psum must see every host's rows.
+    global_shape = (8,)
+    sharding = NamedSharding(mesh, P("data"))
+    full = np.arange(8, dtype=np.float32)
+
+    def local_cb(index):
+        return full[index]
+
+    arr = jax.make_array_from_callback(global_shape, sharding, local_cb)
+
+    @jax.jit
+    def total(a):
+        return jnp.sum(a)
+
+    result = float(total(arr))
+    assert result == float(full.sum()), result
+
+    # Cross-host gradient-style reduction through shard_map psum.
+    from jax.experimental.shard_map import shard_map
+
+    f = shard_map(
+        lambda x: jax.lax.psum(jnp.sum(x), "data"),
+        mesh=mesh, in_specs=P("data"), out_specs=P())
+    assert float(f(arr)) == float(full.sum())
+
+    print(f"MULTIHOST_OK process={pid} total={result}")
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,8 @@ normalization algebra vs materialized normalization, CSR vs dense parity.
 """
 
 import numpy as np
+
+from tests.conftest import gold
 import jax
 import jax.numpy as jnp
 import scipy.sparse as sp
@@ -35,8 +37,8 @@ def test_value_and_grad_match_explicit_formula(rng):
     exp_val = np.sum(w * lo) + 0.5 * l2 * coef @ coef
     dz = 1 / (1 + np.exp(-z)) - y
     exp_grad = x.T @ (w * dz) + l2 * coef
-    np.testing.assert_allclose(float(val), exp_val, rtol=1e-10)
-    np.testing.assert_allclose(np.asarray(grad), exp_grad, rtol=1e-9)
+    np.testing.assert_allclose(float(val), exp_val, rtol=gold(1e-10))
+    np.testing.assert_allclose(np.asarray(grad), exp_grad, rtol=gold(1e-9))
 
 
 def test_hessian_vector_and_diagonal_match_dense_hessian(rng):
@@ -51,14 +53,14 @@ def test_hessian_vector_and_diagonal_match_dense_hessian(rng):
 
     v = np.linspace(-1, 1, 5)
     hv = obj.hessian_vector(jnp.asarray(coef), jnp.asarray(v), batch, l2)
-    np.testing.assert_allclose(np.asarray(hv), H @ v, rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(hv), H @ v, rtol=gold(1e-9))
 
     hd = obj.hessian_diagonal(jnp.asarray(coef), batch, l2)
-    np.testing.assert_allclose(np.asarray(hd), np.diag(H), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(hd), np.diag(H), rtol=gold(1e-9))
 
     var = obj.coefficient_variances(jnp.asarray(coef), batch, l2)
     np.testing.assert_allclose(np.asarray(var), 1 / (np.diag(H) + 1e-12),
-                               rtol=1e-9)
+                               rtol=gold(1e-9))
 
 
 def test_normalization_algebra_equals_materialized(rng):
@@ -85,16 +87,16 @@ def test_normalization_algebra_equals_materialized(rng):
     c = jnp.asarray(coef)
     v1, g1 = obj_norm.value_and_grad(c, batch_raw, 0.2)
     v2, g2 = obj_plain.value_and_grad(c, batch_mat, 0.2)
-    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-10)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-8)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=gold(1e-10))
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=gold(1e-8))
 
     hd1 = obj_norm.hessian_diagonal(c, batch_raw, 0.2)
     hd2 = obj_plain.hessian_diagonal(c, batch_mat, 0.2)
-    np.testing.assert_allclose(np.asarray(hd1), np.asarray(hd2), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(hd1), np.asarray(hd2), rtol=gold(1e-8))
 
     hv1 = obj_norm.hessian_vector(c, c, batch_raw, 0.2)
     hv2 = obj_plain.hessian_vector(c, c, batch_mat, 0.2)
-    np.testing.assert_allclose(np.asarray(hv1), np.asarray(hv2), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(hv1), np.asarray(hv2), rtol=gold(1e-8))
 
 
 def test_model_space_round_trip(rng):
@@ -106,13 +108,13 @@ def test_model_space_round_trip(rng):
     norm = NormalizationContext(jnp.asarray(factors), jnp.asarray(shifts), d - 1)
     c = jnp.asarray(coef)
     back = norm.model_to_normalized_space(norm.model_to_original_space(c))
-    np.testing.assert_allclose(np.asarray(back), coef, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(back), coef, rtol=gold(1e-10))
 
     # Predictions with original-space model on raw x == normalized-space
     # model on normalized x.
     orig = np.asarray(norm.model_to_original_space(c))
     x_norm = (x - shifts) * factors
-    np.testing.assert_allclose(x @ orig, x_norm @ coef, rtol=1e-8)
+    np.testing.assert_allclose(x @ orig, x_norm @ coef, rtol=gold(1e-8))
 
 
 def test_csr_matches_dense(rng):
@@ -128,11 +130,11 @@ def test_csr_matches_dense(rng):
     c = jnp.asarray(coef)
     v1, g1 = obj.value_and_grad(c, dense, 0.05)
     v2, g2 = obj.value_and_grad(c, csr, 0.05)
-    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-10)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-9)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=gold(1e-10))
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=gold(1e-9))
     np.testing.assert_allclose(
         np.asarray(obj.hessian_diagonal(c, dense)),
-        np.asarray(obj.hessian_diagonal(c, csr)), rtol=1e-9)
+        np.asarray(obj.hessian_diagonal(c, csr)), rtol=gold(1e-9))
 
 
 def test_zero_weight_rows_are_inert(rng):
@@ -146,8 +148,8 @@ def test_zero_weight_rows_are_inert(rng):
     c = jnp.asarray(coef)
     v1, g1 = obj.value_and_grad(c, full)
     v2, g2 = obj.value_and_grad(c, trimmed)
-    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-12)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-10)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=gold(1e-12))
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=gold(1e-10))
 
 
 def test_vmap_over_entities(rng):
@@ -167,6 +169,6 @@ def test_vmap_over_entities(rng):
                                 jnp.asarray(ys))
     for b in range(B):
         v, g = one(jnp.asarray(coefs[b]), jnp.asarray(xs[b]), jnp.asarray(ys[b]))
-        np.testing.assert_allclose(float(vals[b]), float(v), rtol=1e-10)
+        np.testing.assert_allclose(float(vals[b]), float(v), rtol=gold(1e-10))
         np.testing.assert_allclose(np.asarray(grads[b]), np.asarray(g),
-                                   rtol=1e-10)
+                                   rtol=gold(1e-10))

@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from tests.conftest import GOLD_RTOL
+
 from photon_ml_tpu.ops.losses import (
     LogisticLoss,
     SquaredLoss,
@@ -28,6 +30,7 @@ def _labels_for(loss, n, rng):
     return (rng.random(n) < 0.5).astype(np.float64)
 
 
+@pytest.mark.needs_f64  # FD with eps=1e-6 only resolves in f64
 @pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
 def test_d1_matches_finite_difference(loss, rng):
     z = jnp.asarray(rng.normal(0, 2, 64))
@@ -37,6 +40,7 @@ def test_d1_matches_finite_difference(loss, rng):
     np.testing.assert_allclose(loss.d1(z, y), fd, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.needs_f64
 @pytest.mark.parametrize(
     "loss", [LogisticLoss, SquaredLoss, PoissonLoss], ids=lambda l: l.name
 )
@@ -52,7 +56,8 @@ def test_logistic_closed_form():
     z = jnp.asarray([0.0, 1.0, -1.0, 30.0, -30.0])
     y = jnp.asarray([1.0, 0.0, 1.0, 0.0, 1.0])
     expected = np.log1p(np.exp(np.asarray(z))) - np.asarray(y) * np.asarray(z)
-    np.testing.assert_allclose(LogisticLoss.loss(z, y), expected, rtol=1e-12)
+    np.testing.assert_allclose(LogisticLoss.loss(z, y), expected,
+                               rtol=GOLD_RTOL)
 
 
 def test_logistic_extreme_margins_are_stable():
@@ -76,7 +81,8 @@ def test_squared_closed_form():
 def test_poisson_closed_form():
     z = jnp.asarray([0.0, 1.0])
     y = jnp.asarray([2.0, 0.0])
-    np.testing.assert_allclose(PoissonLoss.loss(z, y), [1.0, np.e], rtol=1e-12)
+    np.testing.assert_allclose(PoissonLoss.loss(z, y), [1.0, np.e],
+                               rtol=GOLD_RTOL)
 
 
 def test_smoothed_hinge_segments():
@@ -103,5 +109,5 @@ def test_losses_jit_and_grad():
         total = jax.jit(lambda z: jnp.sum(loss.loss(z, y)))
         g = jax.grad(lambda z: jnp.sum(loss.loss(z, y)))(z)
         if loss.twice_differentiable:
-            np.testing.assert_allclose(g, loss.d1(z, y), rtol=1e-10)
+            np.testing.assert_allclose(g, loss.d1(z, y), rtol=GOLD_RTOL)
         assert np.isfinite(float(total(z)))

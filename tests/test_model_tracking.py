@@ -147,3 +147,46 @@ def test_summarize_coefficients_across_models():
     assert len(sums) == 2
     assert sums[0].mean == pytest.approx(2.0)
     assert sums[1].min == 10.0 and sums[1].max == 30.0
+
+
+def test_summarize_trackers_glmix(rng):
+    """Aggregated GAME telemetry: per coordinate per update, solve counts,
+    convergence-reason histogram and iteration/objective stats (reference:
+    RandomEffectOptimizationTracker.countConvergenceReasons +
+    getNumIterationStats)."""
+    import json
+
+    from photon_ml_tpu.models.tracking import summarize_trackers
+    from tests.test_coordinate_descent import (
+        build_coordinates,
+        make_glmix_data,
+    )
+    from photon_ml_tpu.algorithm import CoordinateDescent
+    from photon_ml_tpu.types import TaskType
+
+    data, *_ = make_glmix_data(rng, n=300)
+    cd = CoordinateDescent(build_coordinates(data),
+                           TaskType.LOGISTIC_REGRESSION)
+    res = cd.run(num_iterations=2, seed=3)
+    summary = summarize_trackers(res.trackers)
+
+    assert set(summary) == set(res.trackers)
+    for name, per_update in summary.items():
+        assert len(per_update) == 2  # one entry per CD update
+        for s in per_update:
+            assert s["numSolves"] >= 1
+            assert sum(s["convergenceReasons"].values()) == s["numSolves"]
+            assert all(k in ("NOT_CONVERGED", "MAX_ITERATIONS",
+                             "FUNCTION_VALUES_CONVERGED",
+                             "GRADIENT_CONVERGED",
+                             "OBJECTIVE_NOT_IMPROVING")
+                       for k in s["convergenceReasons"])
+            assert s["iterations"]["max"] >= s["iterations"]["mean"] >= 0
+            assert np.isfinite(s["finalValue"]["mean"])
+    # perUser aggregates one solve per entity.
+    n_entities = sum(
+        c.shape[0]
+        for c in cd.coordinates["perUser"].params_of(
+            cd.coordinates["perUser"].initialize_model()))
+    assert summary["perUser"][0]["numSolves"] == n_entities
+    json.dumps(summary)  # JSON-ready for model-metadata.json

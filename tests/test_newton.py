@@ -7,6 +7,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+
+# Exact Newton is the explicit-use CPU/f64 tool (solver.py routes TPU
+# solves to CG/quasi-Newton); its curvature solves stall around gnorm
+# ~1e-2 in f32, so the module is f64-only.
+pytestmark = pytest.mark.needs_f64
 import scipy.optimize
 
 from photon_ml_tpu.ops import DenseFeatures, GLMObjective, LogisticLoss

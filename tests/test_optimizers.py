@@ -5,6 +5,8 @@ against scipy on real GLM fits.
 """
 
 import numpy as np
+
+from tests.conftest import gold
 import jax
 import jax.numpy as jnp
 import pytest
@@ -84,8 +86,10 @@ def test_logistic_fit_matches_scipy(minimize, rng):
 
     ref = scipy.optimize.minimize(np_obj, np.zeros(8), method="L-BFGS-B",
                                   options={"ftol": 1e-14, "gtol": 1e-10})
-    np.testing.assert_allclose(float(res.value), ref.fun, rtol=1e-8)
-    np.testing.assert_allclose(np.asarray(res.x), ref.x, atol=2e-4)
+    np.testing.assert_allclose(float(res.value), ref.fun,
+                               rtol=gold(1e-8, f32_floor=1e-4))
+    np.testing.assert_allclose(np.asarray(res.x), ref.x,
+                               atol=gold(2e-4, f32_floor=5e-3))
 
 
 def test_box_constraints_match_scipy(rng):
@@ -97,8 +101,8 @@ def test_box_constraints_match_scipy(rng):
     fun = lambda w, b: obj.value(w, b, 0.0)
     res = minimize_lbfgs(fun, jnp.zeros(8), args=(batch,), tol=1e-10,
                          lower_bounds=lo, upper_bounds=hi)
-    assert np.all(np.asarray(res.x) >= lo - 1e-12)
-    assert np.all(np.asarray(res.x) <= hi + 1e-12)
+    assert np.all(np.asarray(res.x) >= lo - gold(1e-12, f32_floor=1e-6))
+    assert np.all(np.asarray(res.x) <= hi + gold(1e-12, f32_floor=1e-6))
 
     def np_obj(w):
         z = x @ w
@@ -109,7 +113,7 @@ def test_box_constraints_match_scipy(rng):
                                   options={"ftol": 1e-14, "gtol": 1e-10})
     # Naive per-step projection (same scheme as the reference, LBFGS.scala:77)
     # stalls slightly vs a true bound-constrained method — allow 1e-4 rel.
-    assert float(res.value) >= ref.fun - 1e-9
+    assert float(res.value) >= ref.fun - gold(1e-9, f32_floor=1e-4)
     np.testing.assert_allclose(float(res.value), ref.fun, rtol=1e-4)
 
 
@@ -136,10 +140,11 @@ def test_owlqn_l1_optimality(rng):
     g = np.asarray(jax.grad(fun)(res.x, batch))
     zero = w == 0
     assert np.any(zero), "l1=8 should zero out some coefficients"
-    assert np.all(np.abs(g[zero]) <= l1 + 1e-4)
+    assert np.all(np.abs(g[zero]) <= l1 + gold(1e-4, f32_floor=1e-2))
     nz = ~zero
     np.testing.assert_allclose(g[nz] + l1 * np.sign(w[nz]),
-                               np.zeros(nz.sum()), atol=2e-3)
+                               np.zeros(nz.sum()),
+                               atol=gold(2e-3, f32_floor=2e-2))
 
 
 def test_owlqn_zero_l1_matches_lbfgs(rng):
@@ -192,7 +197,8 @@ def test_vmap_batched_solves_match_individual(minimize, kw, rng):
         np.testing.assert_allclose(float(batched.value[b]),
                                    float(single.value), rtol=1e-7)
         np.testing.assert_allclose(np.asarray(batched.x[b]),
-                                   np.asarray(single.x), atol=1e-4)
+                                   np.asarray(single.x),
+                                   atol=gold(1e-4, f32_floor=2e-3))
 
 
 def test_owlqn_vmap(rng):
