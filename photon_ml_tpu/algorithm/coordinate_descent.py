@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -114,14 +114,15 @@ class CoordinateDescent:
         initial_model: Optional[GameModel] = None,
         checkpoint_dir=None,
         checkpoint_interval: int = 1,
-        checkpoint_tag: str = "",
+        checkpoint_tag: Union[str, Mapping[str, str]] = "",
     ) -> CoordinateDescentResult:
         """checkpoint_dir: save resumable state every `checkpoint_interval`
         coordinate updates, and resume from the latest checkpoint found
         there (the reference has no mid-training checkpointing — SURVEY §5;
         per-step keys use fold_in so a resumed run is bit-identical to an
         uninterrupted one). checkpoint_tag: caller-supplied configuration
-        fingerprint folded into the checkpoint identity check."""
+        fingerprint (str or mapping) folded into the checkpoint identity
+        check; mappings are compared canonically (key order is cosmetic)."""
         from photon_ml_tpu.utils import checkpoint as ckpt
 
         if checkpoint_interval < 1:
@@ -143,7 +144,10 @@ class CoordinateDescent:
         best_model, best_metric = None, None
         done_steps = 0
         meta = {"seed": seed, "coordinates": names,
-                "taskType": self.task_type.value, "tag": checkpoint_tag}
+                "taskType": self.task_type.value,
+                "tag": (dict(checkpoint_tag)
+                        if isinstance(checkpoint_tag, Mapping)
+                        else checkpoint_tag)}
 
         def _save(step):
             _sync_models()
@@ -161,7 +165,14 @@ class CoordinateDescent:
             latest = ckpt.latest_checkpoint(checkpoint_dir)
             if latest is not None:
                 state = ckpt.load_checkpoint(latest)
-                if state.meta is not None and state.meta != meta:
+                # Canonical-fingerprint comparison: benign dict reordering
+                # (insertion order of the tag/config mapping) hashes the
+                # same, and mapping tags also match their legacy flattened
+                # string form; a changed seed, task type, or updating
+                # SEQUENCE (list order is semantic) still hard-errors.
+                if (state.meta is not None
+                        and not (ckpt.meta_fingerprints(state.meta)
+                                 & ckpt.meta_fingerprints(meta))):
                     raise ValueError(
                         f"checkpoint {latest} belongs to a different "
                         f"configuration (saved {state.meta}, current {meta});"

@@ -162,9 +162,10 @@ class GameEstimator:
                           Path(checkpoint_dir) / f"combo-{combo_index}")
             # Fingerprint the combo's configs: grid changes re-enumerate
             # combo indices, so without this a resume could silently load a
-            # different configuration's state.
-            tag = ";".join(f"{k}={v.to_string()}"
-                           for k, v in sorted(configs.items()))
+            # different configuration's state. A mapping tag is hashed with
+            # sorted keys, so spec/grid reordering that yields the same
+            # configs resumes cleanly.
+            tag = {k: v.to_string() for k, v in configs.items()}
             results.append((configs, cd.run(
                 self.num_iterations, seed=seed,
                 checkpoint_dir=combo_ckpt,

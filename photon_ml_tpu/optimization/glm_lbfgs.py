@@ -111,36 +111,65 @@ def _minimize_lbfgs_glm_impl(
         pp = jnp.vdot(direction, direction)
         gp = jnp.vdot(st.g, direction)
 
-        def trial_value(t):
-            return objective.value_from_margins(
-                st.z + t * zp, xx + 2.0 * t * xp + t * t * pp, batch, l2)
-
         first = st.hist.count == 0
         init_step = jnp.where(
             first, 1.0 / jnp.maximum(jnp.sqrt(pp), 1.0),
             jnp.ones((), dtype))
 
-        def trial(t):
-            f_t = trial_value(t)
-            ok = jnp.logical_and(f_t <= st.f + c1 * t * gp,
-                                 jnp.isfinite(f_t))
-            return ok, f_t
+        # BATCHED Armijo backtracking: margins are affine in the step, so a
+        # block of candidates t_k = init * shrink^k is priced in ONE fused
+        # [K, n] elementwise reduction (a device-loop iteration costs
+        # ~0.14 ms on TPU v5e, so a 5-trial sequential search was ~1 ms of
+        # loop overhead). K is capped at 8 to bound the [K, n] intermediate
+        # on huge shards; the rare candidates beyond the block (shrink^8
+        # ~ 4e-3 of the step) run through the original sequential tail, so
+        # the accepted step — the FIRST candidate satisfying Armijo — is
+        # bit-identical to fully sequential backtracking.
+        n_batched = min(max_line_search + 1, 8)
 
-        def ls_cond(s):
-            ok, _, _, k = s
-            return jnp.logical_and(~ok, k < max_line_search)
+        def trial_values(ts):
+            z_trials = st.z[None, :] + ts[:, None] * zp[None, :]
+            data_terms = jnp.sum(
+                batch.weights[None, :]
+                * objective.loss.loss(z_trials, batch.labels[None, :]),
+                axis=-1)
+            coef_sq = xx + 2.0 * ts * xp + ts * ts * pp
+            return data_terms + 0.5 * l2 * coef_sq
 
-        def ls_body(s):
-            _, _, t, k = s
-            t = t * shrink
-            ok, f_t = trial(t)
-            return ok, f_t, t, k + 1
+        def armijo_ok(ts, f_trials):
+            return jnp.logical_and(f_trials <= st.f + c1 * ts * gp,
+                                   jnp.isfinite(f_trials))
 
-        ok0, f0_t = trial(init_step)
-        ok, f_new, t_acc, _ = lax.while_loop(
-            ls_cond, ls_body,
-            (ok0, f0_t, jnp.asarray(init_step, dtype),
-             jnp.zeros((), jnp.int32)))
+        ks = jnp.arange(n_batched, dtype=dtype)
+        ts = init_step * jnp.power(jnp.asarray(shrink, dtype), ks)  # [K]
+        f_trials = trial_values(ts)
+        armijo = armijo_ok(ts, f_trials)
+        ok = jnp.any(armijo)
+        idx = jnp.argmax(armijo)  # first True (argmax of bool)
+        t_acc = ts[idx]
+        f_new = f_trials[idx]
+
+        if max_line_search + 1 > n_batched:
+            # Sequential tail for candidates past the batched block —
+            # normally 0 iterations (the cond sees ok=True immediately).
+            def ls_cond(s):
+                tail_ok, _, _, k = s
+                return jnp.logical_and(~tail_ok, k < max_line_search + 1)
+
+            def ls_body(s):
+                _, _, t, k = s
+                t = t * shrink
+                f_t = trial_values(t[None])[0]
+                t_ok = jnp.logical_and(
+                    f_t <= st.f + c1 * t * gp, jnp.isfinite(f_t))
+                return t_ok, f_t, t, k + 1
+
+            ok, f_new_t, t_tail, _ = lax.while_loop(
+                ls_cond, ls_body,
+                (ok, f_new, ts[-1], jnp.asarray(n_batched, jnp.int32)))
+            in_tail = ~jnp.any(armijo)
+            t_acc = jnp.where(in_tail, t_tail, t_acc)
+            f_new = jnp.where(in_tail, f_new_t, f_new)
 
         x_new = st.x + t_acc * direction
         z_new = st.z + t_acc * zp

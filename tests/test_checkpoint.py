@@ -113,6 +113,78 @@ def test_resume_rejects_mismatched_configuration(rng, tmp_path):
                 checkpoint_interval=0)
 
 
+def test_resume_survives_benign_tag_reordering(rng, tmp_path):
+    """Checkpoint identity is a canonical hash: a mapping tag with a
+    different insertion order is the SAME configuration and must resume;
+    a changed updating sequence is a DIFFERENT one and must hard-error."""
+    data, *_ = make_glmix_data(rng, n=200)
+    tag = {"fixed": "10,1e-4,1.0,LBFGS,L2", "perUser": "5,1e-4,1.0,LBFGS,L2"}
+    cd = CoordinateDescent(build_coordinates(data),
+                           TaskType.LOGISTIC_REGRESSION)
+    first = cd.run(num_iterations=1, seed=1, checkpoint_dir=tmp_path,
+                   checkpoint_tag=tag)
+
+    reordered = dict(reversed(list(tag.items())))
+    assert list(reordered) != list(tag)  # genuinely different insertion order
+    cd2 = CoordinateDescent(build_coordinates(data),
+                            TaskType.LOGISTIC_REGRESSION)
+    second = cd2.run(num_iterations=1, seed=1, checkpoint_dir=tmp_path,
+                     checkpoint_tag=reordered)  # must NOT raise
+    np.testing.assert_allclose(_final_coefs(second), _final_coefs(first),
+                               rtol=1e-7)
+
+    # Changed updating sequence (list order is semantic) still rejects.
+    coords = build_coordinates(data)
+    swapped = {k: coords[k] for k in reversed(list(coords))}
+    cd3 = CoordinateDescent(swapped, TaskType.LOGISTIC_REGRESSION)
+    with pytest.raises(ValueError, match="different configuration"):
+        cd3.run(num_iterations=1, seed=1, checkpoint_dir=tmp_path,
+                checkpoint_tag=tag)
+
+    # A semantically different tag value rejects too.
+    changed = dict(tag, fixed="99,1e-4,1.0,TRON,L2")
+    cd4 = CoordinateDescent(build_coordinates(data),
+                            TaskType.LOGISTIC_REGRESSION)
+    with pytest.raises(ValueError, match="different configuration"):
+        cd4.run(num_iterations=1, seed=1, checkpoint_dir=tmp_path,
+                checkpoint_tag=changed)
+
+
+def test_config_fingerprint_canonicalization():
+    from photon_ml_tpu.utils.checkpoint import config_fingerprint
+
+    a = {"x": 1, "y": {"b": 2, "a": 3}, "seq": ["f", "r"]}
+    b = {"y": {"a": 3, "b": 2}, "seq": ["f", "r"], "x": 1}
+    assert config_fingerprint(a) == config_fingerprint(b)
+    # List order is semantic.
+    c = dict(a, seq=["r", "f"])
+    assert config_fingerprint(c) != config_fingerprint(a)
+
+
+def test_legacy_string_tag_still_resumes(rng, tmp_path):
+    """Checkpoints written when tags were flattened 'k=v;...' strings must
+    resume under the equivalent mapping tag (and vice versa)."""
+    from photon_ml_tpu.utils.checkpoint import meta_fingerprints
+
+    tag_map = {"fixed": "10,1e-4,1.0,LBFGS,L2", "perUser": "5,..."}
+    legacy = ";".join(f"{k}={v}" for k, v in sorted(tag_map.items()))
+    old_meta = {"seed": 1, "coordinates": ["fixed", "perUser"],
+                "taskType": "LOGISTIC_REGRESSION", "tag": legacy}
+    new_meta = dict(old_meta, tag=tag_map)
+    assert meta_fingerprints(old_meta) & meta_fingerprints(new_meta)
+
+    # End-to-end: save under the legacy string, resume under the mapping.
+    data, *_ = make_glmix_data(rng, n=200)
+    cd = CoordinateDescent(build_coordinates(data),
+                           TaskType.LOGISTIC_REGRESSION)
+    cd.run(num_iterations=1, seed=1, checkpoint_dir=tmp_path,
+           checkpoint_tag=legacy)
+    cd2 = CoordinateDescent(build_coordinates(data),
+                            TaskType.LOGISTIC_REGRESSION)
+    cd2.run(num_iterations=1, seed=1, checkpoint_dir=tmp_path,
+            checkpoint_tag=tag_map)  # must NOT raise
+
+
 def test_resume_preserves_best_model_and_validation(rng, tmp_path):
     data, *_ = make_glmix_data(rng, n=300)
     vdata, *_ = make_glmix_data(rng, n=120)
