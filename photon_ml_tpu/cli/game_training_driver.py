@@ -99,6 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-types", default=None,
                    help="extra entity id columns to read from metadataMap "
                         "(defaults to the random-effect types)")
+    p.add_argument("--feature-index-dir", default=None,
+                   help="pre-built feature index stores keyed by shard id: "
+                        "the reference's partitioned PalDB stores "
+                        "(paldb-partition-<shard>-<N>.dat, "
+                        "ml/util/PalDBIndexMap.scala) or this package's "
+                        "<shard>.json stores; replaces the Avro-scan "
+                        "index-building pass")
     p.add_argument("--profile-output-dir", default=None,
                    help="write a jax.profiler trace of training here "
                         "(view with XProf/TensorBoard)")
@@ -148,12 +155,22 @@ def run(argv=None) -> dict:
         {c.random_effect_type for c in fre_data.values()} |
         {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
 
+    preloaded_maps = None
+    if args.feature_index_dir:
+        from photon_ml_tpu.data.paldb import load_feature_index_maps
+
+        preloaded_maps = load_feature_index_maps(args.feature_index_dir)
+        logger.info(
+            "loaded feature index stores from %s: %s", args.feature_index_dir,
+            {k: len(v) for k, v in sorted(preloaded_maps.items())})
+
     train_inputs = resolve_input_dirs(
         args.train_input_dirs,
         date_range=args.train_date_range,
         date_range_days_ago=args.train_date_range_days_ago)
     logger.info("reading training data from %s", train_inputs)
-    data, shard_maps = read_game_dataset(train_inputs, id_types=id_types)
+    data, shard_maps = read_game_dataset(train_inputs, id_types=id_types,
+                                         feature_shard_maps=preloaded_maps)
     validation = None
     if args.validate_input_dirs:
         validate_inputs = resolve_input_dirs(

@@ -67,6 +67,38 @@ def _record_features(rec: dict) -> Iterable[dict]:
     return (f for f in (rec.get("features") or ()) if f is not None)
 
 
+def _reject_duplicate_features(mat: sp.csr_matrix, index_map: IndexMap,
+                               uids: Sequence, shard: str = "") -> None:
+    """Hard-reject records carrying the same (name, term) feature twice.
+
+    Mirrors the reference's AvroDataReader validation
+    (ml/data/AvroDataReader.scala:306-311: `require(duplicateFeatures
+    .isEmpty, ...)`): the same input must produce the same error, not a
+    silently different model (summing duplicates changes the fit).
+    Runs on the raw CSR triplet BEFORE sum_duplicates collapses them.
+    """
+    row_ids = np.repeat(np.arange(mat.shape[0]), np.diff(mat.indptr))
+    order = np.lexsort((mat.indices, row_ids))
+    r = row_ids[order]
+    c = mat.indices[order]
+    dup = (r[1:] == r[:-1]) & (c[1:] == c[:-1])
+    if not dup.any():
+        return
+    hits = np.nonzero(dup)[0][:5]
+    details = []
+    for i in hits:
+        row, col = int(r[i]), int(c[i])
+        uid = uids[row] if uids is not None and row < len(uids) else None
+        details.append(
+            f"row {row}" + (f" (uid {uid!r})" if uid else "")
+            + f": feature {index_map.get_feature_name(col)!r}")
+    where = f" in feature shard {shard!r}" if shard else ""
+    raise ValueError(
+        f"duplicate (name, term) features detected{where} — the reference "
+        "rejects such records (AvroDataReader.scala:306-311): "
+        + "; ".join(details))
+
+
 def build_index_map(path, add_intercept: bool = True,
                     selected_features: Optional[set] = None) -> IndexMap:
     """Scan pass collecting distinct (name, term) keys — the analog of
@@ -118,6 +150,7 @@ def read_labeled_points(
         data_, idx_, indptr_ = fast.shards["m"]
         mat = sp.csr_matrix((data_, idx_, indptr_),
                             shape=(len(fast.labels), len(index_map)))
+        _reject_duplicate_features(mat, index_map, fast.uids)
         mat.sum_duplicates()
         return (mat, fast.labels, fast.offsets, fast.weights, fast.uids,
                 index_map)
@@ -147,6 +180,7 @@ def read_labeled_points(
     mat = sp.csr_matrix(
         (np.asarray(data), np.asarray(indices, np.int64),
          np.asarray(indptr, np.int64)), shape=(n, d))
+    _reject_duplicate_features(mat, index_map, uids)
     mat.sum_duplicates()
     return (mat, np.asarray(labels), np.asarray(offsets),
             np.asarray(weights), uids, index_map)
@@ -184,6 +218,7 @@ def read_game_dataset(
             data_, idx_, indptr_ = fast.shards[shard]
             m = sp.csr_matrix((data_, idx_, indptr_),
                               shape=(n, len(imap)))
+            _reject_duplicate_features(m, imap, fast.uids, shard)
             m.sum_duplicates()
             shards[shard] = m
         data = GameDataset.build(
@@ -237,6 +272,7 @@ def read_game_dataset(
         m = sp.csr_matrix(
             (np.asarray(b["data"]), np.asarray(b["indices"], np.int64),
              np.asarray(b["indptr"], np.int64)), shape=(n, len(imap)))
+        _reject_duplicate_features(m, imap, uids, shard)
         m.sum_duplicates()
         shards[shard] = m
 

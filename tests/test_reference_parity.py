@@ -278,3 +278,22 @@ def test_a9a_train_avro_validation():
     # semantic drift (loss, regularization, ingest alignment) falls well
     # below this bar.
     assert auc >= 0.88, auc
+
+
+GAME_INPUT = Path(
+    "/root/reference/photon-ml/src/integTest/resources/GameIntegTest/input")
+
+
+def test_duplicate_features_rejected_like_reference():
+    """The reference hard-rejects records with duplicate (name, term)
+    features (AvroDataReader.scala:306-311) and ships a fixture for it;
+    this implementation must fail the same input the same way, not
+    silently sum the duplicates into a different model."""
+    fixture = GAME_INPUT / "duplicateFeatures" / "yahoo-music-train.avro"
+    with pytest.raises(ValueError, match="duplicate"):
+        read_labeled_points(fixture)
+
+    from photon_ml_tpu.data.avro_reader import read_game_dataset
+
+    with pytest.raises(ValueError, match="duplicate"):
+        read_game_dataset(fixture, id_types=[])
