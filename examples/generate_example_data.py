@@ -64,6 +64,28 @@ def game_records(rng, n, w, user_bias):
     return out
 
 
+def game_full_records(rng, n, w, user_bias, user_vecs, item_vecs):
+    """Full-GAME shape: global fixed effect + per-user bias + a low-rank
+    user x item interaction (the structure a factored/MF coordinate
+    recovers), userId AND movieId in metadataMap."""
+    out = []
+    n_users, n_items = len(user_bias), len(item_vecs)
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        m = int(rng.integers(0, n_items))
+        x = rng.normal(0, 1, len(w))
+        z = float(x @ w + user_bias[u] + user_vecs[u] @ item_vecs[m])
+        out.append({
+            "uid": f"r{i}",
+            "label": float(rng.random() < 1 / (1 + np.exp(-z))),
+            "features": [{"name": f"x{j}", "term": None, "value": float(v)}
+                         for j, v in enumerate(x)],
+            "weight": None, "offset": None,
+            "metadataMap": {"userId": f"user{u}", "movieId": f"movie{m}"},
+        })
+    return out
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data-dir", type=Path, default=Path("example-data"))
@@ -86,6 +108,18 @@ def main(argv=None) -> None:
            game_records(rng, args.num_train, w_game, bias))
     _write(args.data_dir / "game" / "validate",
            game_records(rng, args.num_validate, w_game, bias))
+
+    # Full-GAME dataset (run_game_full.sh): adds movieId + a rank-2
+    # user x item interaction for the factored/MF coordinate.
+    n_items = max(10, args.num_users // 2)
+    uvecs = rng.normal(0, 0.7, (args.num_users, 2))
+    ivecs = rng.normal(0, 0.7, (n_items, 2))
+    _write(args.data_dir / "game-full" / "train",
+           game_full_records(rng, args.num_train, w_game, bias,
+                             uvecs, ivecs))
+    _write(args.data_dir / "game-full" / "validate",
+           game_full_records(rng, args.num_validate, w_game, bias,
+                             uvecs, ivecs))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Full-surface GAME demo (reference: cli/game/training DriverTest's
+# fixed+random+factored matrix): a TRON-solved fixed effect, an
+# elastic-net per-user random effect (OWL-QN path), and a factored
+# (matrix-factorization) per-movie coordinate — then batch scoring with
+# the saved model. Exercises every solver family and the latent-factor
+# model IO (ml/avro/model/ModelProcessingUtils.scala:67-130).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DATA_DIR="${DATA_DIR:-example-data}"
+OUT_DIR="${OUT_DIR:-example-out/game-full}"
+
+[ -d "$DATA_DIR/game-full/train" ] || python examples/generate_example_data.py --data-dir "$DATA_DIR"
+rm -rf "$OUT_DIR"
+
+# Optimizer mini-DSL: maxIter,tol,lambda,downSampleRate,optimizer,regType
+#  - fixed:     TRON + L2 (trust-region Newton-CG, TRON.scala defaults)
+#  - perUser:   L-BFGS/OWL-QN + ELASTIC_NET (alpha folded via regType)
+#  - perMovie:  factored coordinate "reOpt;latentOpt;mfMaxIter,numFactors"
+python -m photon_ml_tpu.cli.game_training_driver \
+  --train-input-dirs "$DATA_DIR/game-full/train" \
+  --validate-input-dirs "$DATA_DIR/game-full/validate" \
+  --output-dir "$OUT_DIR/model" \
+  --task-type LOGISTIC_REGRESSION \
+  --fixed-effect-data-configurations "fixed:global" \
+  --fixed-effect-optimization-configurations "fixed:15,1e-5,1.0,1.0,TRON,L2" \
+  --random-effect-data-configurations "perUser:userId,global,4,-1,-1,-1" \
+  --random-effect-optimization-configurations "perUser:30,1e-6,0.5,1.0,LBFGS,ELASTIC_NET,0.5" \
+  --factored-random-effect-data-configurations "perMovie:movieId,global,4,-1,-1,-1,IDENTITY" \
+  --factored-random-effect-optimization-configurations \
+      "perMovie:20,1e-6,1.0,1.0,LBFGS,L2;20,1e-6,1.0,1.0,LBFGS,L2;2,2" \
+  --updating-sequence fixed,perUser,perMovie \
+  --num-iterations 3 \
+  --evaluators AUC,LOGISTIC_LOSS
+
+python -m photon_ml_tpu.cli.game_scoring_driver \
+  --input-dirs "$DATA_DIR/game-full/validate" \
+  --game-model-input-dir "$OUT_DIR/model/best" \
+  --output-dir "$OUT_DIR/scores" \
+  --evaluators AUC
+
+echo
+echo "Latent-factor artifacts (factored/MF coordinate):"
+find "$OUT_DIR/model/best" -name '*latent*' | sed 's/^/  /'
+echo "Outputs:"
+find "$OUT_DIR" -maxdepth 3 -name '*.json' | sed 's/^/  /'

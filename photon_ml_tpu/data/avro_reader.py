@@ -69,21 +69,31 @@ def _record_features(rec: dict) -> Iterable[dict]:
 
 def _reject_duplicate_features(mat: sp.csr_matrix, index_map: IndexMap,
                                uids: Sequence, shard: str = "") -> None:
-    """Hard-reject records carrying the same (name, term) feature twice.
+    """Hard-reject records carrying the same (name, term) feature twice,
+    then canonicalize the matrix (sum_duplicates).
 
     Mirrors the reference's AvroDataReader validation
     (ml/data/AvroDataReader.scala:306-311: `require(duplicateFeatures
     .isEmpty, ...)`): the same input must produce the same error, not a
     silently different model (summing duplicates changes the fit).
-    Runs on the raw CSR triplet BEFORE sum_duplicates collapses them.
+
+    Detection is nearly free on the clean path: duplicates exist iff
+    sum_duplicates shrinks nnz (the pre-call structure must be COPIED —
+    sum_duplicates compacts indices/indptr in place). The O(nnz log nnz)
+    labeling lexsort runs only on the terminal error path.
     """
-    row_ids = np.repeat(np.arange(mat.shape[0]), np.diff(mat.indptr))
-    order = np.lexsort((mat.indices, row_ids))
-    r = row_ids[order]
-    c = mat.indices[order]
-    dup = (r[1:] == r[:-1]) & (c[1:] == c[:-1])
-    if not dup.any():
+    raw_indices = mat.indices.copy()
+    raw_indptr = mat.indptr.copy()
+    nnz_before = mat.nnz
+    mat.sum_duplicates()
+    if mat.nnz == nnz_before:
         return
+    row_ids = np.repeat(np.arange(len(raw_indptr) - 1),
+                        np.diff(raw_indptr))
+    order = np.lexsort((raw_indices, row_ids))
+    r = row_ids[order]
+    c = raw_indices[order]
+    dup = (r[1:] == r[:-1]) & (c[1:] == c[:-1])
     hits = np.nonzero(dup)[0][:5]
     details = []
     for i in hits:
@@ -151,7 +161,6 @@ def read_labeled_points(
         mat = sp.csr_matrix((data_, idx_, indptr_),
                             shape=(len(fast.labels), len(index_map)))
         _reject_duplicate_features(mat, index_map, fast.uids)
-        mat.sum_duplicates()
         return (mat, fast.labels, fast.offsets, fast.weights, fast.uids,
                 index_map)
 
@@ -181,7 +190,6 @@ def read_labeled_points(
         (np.asarray(data), np.asarray(indices, np.int64),
          np.asarray(indptr, np.int64)), shape=(n, d))
     _reject_duplicate_features(mat, index_map, uids)
-    mat.sum_duplicates()
     return (mat, np.asarray(labels), np.asarray(offsets),
             np.asarray(weights), uids, index_map)
 
@@ -219,7 +227,6 @@ def read_game_dataset(
             m = sp.csr_matrix((data_, idx_, indptr_),
                               shape=(n, len(imap)))
             _reject_duplicate_features(m, imap, fast.uids, shard)
-            m.sum_duplicates()
             shards[shard] = m
         data = GameDataset.build(
             responses=fast.labels,
@@ -273,7 +280,6 @@ def read_game_dataset(
             (np.asarray(b["data"]), np.asarray(b["indices"], np.int64),
              np.asarray(b["indptr"], np.int64)), shape=(n, len(imap)))
         _reject_duplicate_features(m, imap, uids, shard)
-        m.sum_duplicates()
         shards[shard] = m
 
     data = GameDataset.build(

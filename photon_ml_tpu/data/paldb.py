@@ -68,26 +68,37 @@ def _unpack_varint(buf: bytes, pos: int) -> Tuple[int, int]:
 
 
 def _decode_value(buf: bytes, pos: int, end: int) -> Union[int, str]:
-    """Decode one serialized PalDB object in buf[pos:end]."""
+    """Decode one serialized PalDB object in buf[pos:end], enforcing that
+    the decode consumes EXACTLY the declared bytes — a truncated or
+    corrupt entry fails loudly instead of mis-decoding into its
+    neighbor's bytes."""
+    start = pos
     t = buf[pos]
     pos += 1
     if 0x05 <= t <= 0x0D:  # small ints 0..8, immediate
-        return t - 0x05
-    if t == 0x0E:  # unsigned byte
-        return buf[pos]
-    if t == 0x10:  # packed varint
-        return _unpack_varint(buf, pos)[0]
-    if t == 0x67:  # string: char count + per-char varints
+        value: Union[int, str] = t - 0x05
+    elif t == 0x0E:  # unsigned byte
+        value = buf[pos]
+        pos += 1
+    elif t == 0x10:  # packed varint
+        value, pos = _unpack_varint(buf, pos)
+    elif t == 0x67:  # string: char count + per-char varints
         n, pos = _unpack_varint(buf, pos)
         chars = []
         for _ in range(n):
             c, pos = _unpack_varint(buf, pos)
             chars.append(chr(c))
-        return "".join(chars)
-    raise ValueError(
-        f"unsupported PalDB serialization type byte 0x{t:02x} at {pos - 1} "
-        "(only the int/str encodings produced by PalDBIndexMapBuilder are "
-        "supported)")
+        value = "".join(chars)
+    else:
+        raise ValueError(
+            f"unsupported PalDB serialization type byte 0x{t:02x} at "
+            f"{pos - 1} (only the int/str encodings produced by "
+            "PalDBIndexMapBuilder are supported)")
+    if pos != end:
+        raise ValueError(
+            f"corrupt PalDB entry at {start}: decoded {pos - start} bytes, "
+            f"declared {end - start}")
+    return value
 
 
 def read_paldb_store(path) -> Iterator[Tuple[Union[int, str],

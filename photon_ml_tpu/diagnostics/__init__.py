@@ -7,8 +7,10 @@ TPU-first design: training-heavy diagnostics (fitting curves, bootstrap)
 reuse the jitted GLM solve path — a subset re-fit is one more call of the
 same compiled kernel, not a new Spark job. The statistics themselves are
 host-side numpy/scipy (they are O(n) postprocessing, not device work).
-Reports render to JSON + a small self-contained HTML page instead of the
-reference's xchart raster plots.
+Reports render to JSON + a small self-contained HTML page whose charts
+(learning curves, bootstrap CI whiskers, Hosmer-Lemeshow calibration,
+feature importance) are dependency-free inline SVG (svg_charts.py) —
+the vector replacement for the reference's xchart raster plots.
 """
 
 from photon_ml_tpu.diagnostics.bootstrap import (

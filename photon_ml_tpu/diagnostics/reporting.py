@@ -3,9 +3,12 @@ ml/diagnostics/DiagnosticMode.scala and the reporting framework under
 ml/diagnostics/reporting/{base,html,text,reports}/ — logical chapters and
 sections rendered to model-diagnostic.html via ml/Driver.scala:617-637).
 
-The xchart raster plots are replaced by a JSON document (the data behind
-every plot) plus a small self-contained HTML page with tables — the
-SURVEY §2.11 guidance ("notebook-friendly JSON").
+Every plot the reference renders via xchart (learning curves, bootstrap
+confidence intervals, Hosmer-Lemeshow calibration — photon-ml/build.gradle:61,
+ml/diagnostics/reporting/html/) is rendered here as dependency-free inline
+SVG (diagnostics/svg_charts.py) alongside the data tables, and the full data
+behind every chart also lands in model-diagnostic.json ("notebook-friendly
+JSON", SURVEY §2.11).
 """
 
 from __future__ import annotations
@@ -16,6 +19,8 @@ import html
 import json
 from pathlib import Path
 from typing import Any, Dict, List, Optional
+
+from photon_ml_tpu.diagnostics import svg_charts
 
 
 class DiagnosticMode(str, enum.Enum):
@@ -82,6 +87,14 @@ class DiagnosticReport:
                 "models": [m.to_dict() for m in self.models]}
 
 
+def _feature_label(key: Any) -> str:
+    """Human-readable 'name' / 'name:term' from a \\x01-delimited key."""
+    from photon_ml_tpu.data.index_map import split_key
+
+    name, term = split_key(str(key))
+    return f"{name}:{term}" if term else name
+
+
 def _render_value(value: Any) -> str:
     if isinstance(value, float):
         return f"{value:.6g}"
@@ -131,11 +144,23 @@ def render_html_report(report: DiagnosticReport, title: str =
             parts.append(
                 f"<h3>Feature importance: "
                 f"{html.escape(fi.get('importanceType', ''))}</h3>")
-            parts.append(_render_table(fi.get("rankedFeatures", [])[:20]))
+            ranked = fi.get("rankedFeatures", [])[:20]
+            bars = [(_feature_label(r.get("name", r.get("feature", i)))[:12],
+                     float(r.get("importance", 0.0)))
+                    for i, r in enumerate(ranked)]
+            parts.append(svg_charts.bar_chart(
+                bars, ylabel="importance"))
+            parts.append(_render_table(ranked))
         if chapter.fitting:
             parts.append("<h3>Learning curves</h3>")
             for metric, curve in chapter.fitting.get("metrics", {}).items():
                 parts.append(f"<h4>{html.escape(metric)}</h4>")
+                # The fitting-diagnostic plot (reference:
+                # ml/diagnostics/fitting/FittingDiagnostic + xchart).
+                parts.append(svg_charts.line_chart(
+                    {"train": (curve["dataPortions"], curve["train"]),
+                     "holdout": (curve["dataPortions"], curve["holdout"])},
+                    xlabel="training data portion", ylabel=metric))
                 parts.append(_render_table([
                     {"data %": p, "train": tr, "holdout": te}
                     for p, tr, te in zip(curve["dataPortions"],
@@ -143,10 +168,19 @@ def render_html_report(report: DiagnosticReport, title: str =
                                          curve["holdout"])]))
         if chapter.bootstrap:
             parts.append("<h3>Bootstrap metric confidence intervals</h3>")
+            intervals = chapter.bootstrap.get("metricIntervals", {})
+            # Whisker plot over the bootstrap-replicate distribution:
+            # whiskers span min..max across replicates, dot = mean (the
+            # fields CoefficientSummary.to_dict emits; reference chapter:
+            # BootstrapReport + xchart).
+            parts.append(svg_charts.interval_chart(
+                [(name, float(s["min"]), float(s["mean"]), float(s["max"]))
+                 for name, s in intervals.items()
+                 if all(k in s for k in ("min", "mean", "max"))],
+                ylabel="metric (min / mean / max over replicates)"))
             parts.append(_render_table([
                 {"metric": name, **summary}
-                for name, summary in
-                chapter.bootstrap.get("metricIntervals", {}).items()]))
+                for name, summary in intervals.items()]))
         if chapter.hosmer_lemeshow:
             hl = chapter.hosmer_lemeshow
             parts.append("<h3>Hosmer-Lemeshow goodness of fit</h3>")
@@ -154,7 +188,18 @@ def render_html_report(report: DiagnosticReport, title: str =
                 "chiSquare": hl["chiSquare"],
                 "degreesOfFreedom": hl["degreesOfFreedom"],
                 "pValue": hl["pValue"]}))
-            parts.append(_render_table(hl.get("bins", [])))
+            bins = hl.get("bins", [])
+            if bins:
+                # Calibration bars: expected vs observed positives per
+                # score decile (reference: ml/diagnostics/hl/ + xchart).
+                parts.append(svg_charts.grouped_bar_chart(
+                    [str(i + 1) for i in range(len(bins))],
+                    {"expected": [float(b.get("expectedPos", 0.0))
+                                  for b in bins],
+                     "observed": [float(b.get("observedPos", 0.0))
+                                  for b in bins]},
+                    xlabel="score decile", ylabel="positives"))
+            parts.append(_render_table(bins))
         if chapter.prediction_error_independence:
             parts.append("<h3>Prediction/error independence "
                          "(Kendall tau)</h3>")

@@ -223,10 +223,43 @@ def save_game_model(
             (root / RANDOM_DIR / name / ID_INFO_FILE).write_text(
                 json.dumps({"randomEffectType": model.random_effect_type,
                             "featureShardId": model.feature_shard_id}))
-            meta["coordinates"].append({
+            coord_meta = {
                 "name": name, "kind": "random",
                 "randomEffectType": model.random_effect_type,
-                "featureShardId": model.feature_shard_id})
+                "featureShardId": model.feature_shard_id}
+            if isinstance(model, FactoredRandomEffectModel):
+                # Beyond the converted original-space coefficients (the
+                # reference's on-disk form), persist the factored
+                # decomposition itself: per-entity latent gamma and the
+                # shared projection B, as LatentFactorAvro (the same
+                # schema the reference uses for MF factors,
+                # ml/avro/model/ModelProcessingUtils.scala:400-424).
+                ld = root / RANDOM_DIR / name / "latent"
+                ld.mkdir(parents=True, exist_ok=True)
+                latent = model.latent
+                k = int(np.asarray(model.projection_matrix).shape[0])
+                gamma_recs = []
+                for coefs, codes in zip(latent.local_coefs,
+                                        latent.entity_codes):
+                    c = np.asarray(coefs)[:, :k]
+                    for i, code in enumerate(codes):
+                        gamma_recs.append({
+                            "effectId": str(latent.vocabulary[code]),
+                            "latentFactor": [float(v) for v in c[i]]})
+                gamma_recs.sort(key=lambda r: r["effectId"])
+                write_container(ld / "gamma-latent-factors.avro",
+                                schemas.LATENT_FACTOR, gamma_recs)
+                write_container(
+                    ld / "projection-latent-factors.avro",
+                    schemas.LATENT_FACTOR,
+                    [{"effectId": f"factor-{i}",
+                      "latentFactor": [float(v) for v in row]}
+                     for i, row in enumerate(
+                         np.asarray(model.projection_matrix))])
+                coord_meta["factored"] = {
+                    "numFactors": int(model.mf_config.num_factors),
+                    "mfMaxIterations": int(model.mf_config.max_iterations)}
+            meta["coordinates"].append(coord_meta)
         elif isinstance(model, MatrixFactorizationModel):
             d = root / "matrix-factorization" / name
             d.mkdir(parents=True, exist_ok=True)

@@ -78,11 +78,12 @@ class CSRFeatures:
     n_rows / n_features are static Python ints (aux data) — they fix the
     output shapes for XLA.
 
-    Kernel note (SURVEY §7 hard-part 1 contingency): XLA's sorted
-    segment_sum/gather lowering was measured on TPU v5e at ~0.04 ms matvec /
-    0.18 ms rmatvec for 2M nnz (200k x 10k @ 0.1% density) — memory-bound at
-    near peak; a custom Pallas SpMV has nothing left to win, so the
-    jnp path below IS the kernel.
+    Kernel note (revised after direct measurement, TPU v5e): XLA lowers
+    segment_sum to scatter-add at ~120M updates/s regardless of index
+    sortedness — fine for small/medium nnz, but ~100x off the roofline at
+    scale. For large sparse problems use BlockedEllFeatures below, whose
+    products are gather-only (measured 6.7x faster end-to-end on a
+    d=2M / 12M-nnz solve; see docs/SCALE.md).
     """
 
     values: Array  # f[nnz]
@@ -193,7 +194,287 @@ class KroneckerFeatures:
         return cls(*children)
 
 
-FeatureMatrix = Union[DenseFeatures, CSRFeatures, KroneckerFeatures]
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class BlockedCSRFeatures:
+    """CSR partitioned into column blocks — the SPARSE feature-dimension-
+    sharded layout for d beyond per-chip HBM (SURVEY §5: the reference's
+    #features axis, treeAggregate depth 2 past 200k features,
+    GameEstimator.scala:330-334; README "hundreds of billions of
+    coefficients" is a sparse regime, so densifying is a non-starter).
+
+    nnz entries are routed to the block owning their column; each block
+    stores LOCAL column ids (col - block*block_size) padded to the max
+    block nnz.
+    With the leading block axis sharded over the mesh and coefficients
+    sharded to match ([kb, block_size]):
+
+    - ``matvec``: per-block partial margins (gather + segment_sum over the
+      full row space) then a sum over blocks — XLA lowers the block-axis
+      reduction to an ICI psum of partial margins.
+    - ``rmatvec``: per-block scatter into the block's OWN coefficient
+      slice — no communication; the gradient comes back sharded exactly
+      like the coefficients.
+
+    Also a fine single-device layout (blocks just batch).
+    """
+
+    values: Array  # f[kb, m]
+    col_local: Array  # i32[kb, m] — column - block_start, in [0, block)
+    row_ids: Array  # i32[kb, m]
+    n_rows: int
+    n_features: int  # padded: kb * block_size
+    block_size: int
+
+    @property
+    def num_blocks(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.n_features)
+
+    @property
+    def num_features(self) -> int:
+        return self.n_features
+
+    def _coef_blocks(self, v: Array) -> Array:
+        return v.reshape(self.num_blocks, self.block_size)
+
+    def matvec(self, v: Array) -> Array:
+        vb = self._coef_blocks(v)
+        contrib = self.values * jnp.take_along_axis(
+            vb, self.col_local, axis=1)
+        partial = jax.vmap(
+            lambda c, r: jax.ops.segment_sum(c, r, num_segments=self.n_rows)
+        )(contrib, self.row_ids)  # [kb, n_rows]
+        return jnp.sum(partial, axis=0)
+
+    def rmatvec(self, u: Array) -> Array:
+        contrib = self.values * u[self.row_ids]
+        out = jax.vmap(
+            lambda c, col: jax.ops.segment_sum(
+                c, col, num_segments=self.block_size)
+        )(contrib, self.col_local)  # [kb, block]
+        return out.reshape(-1)
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        vb = self._coef_blocks(v)
+        contrib = (self.values * self.values) * jnp.take_along_axis(
+            vb, self.col_local, axis=1)
+        partial = jax.vmap(
+            lambda c, r: jax.ops.segment_sum(c, r, num_segments=self.n_rows)
+        )(contrib, self.row_ids)
+        return jnp.sum(partial, axis=0)
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        contrib = (self.values * self.values) * u[self.row_ids]
+        out = jax.vmap(
+            lambda c, col: jax.ops.segment_sum(
+                c, col, num_segments=self.block_size)
+        )(contrib, self.col_local)
+        return out.reshape(-1)
+
+    def tree_flatten(self):
+        return (self.values, self.col_local, self.row_ids), (
+            self.n_rows, self.n_features, self.block_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+def blocked_csr_from_scipy(mat, num_blocks: int,
+                           dtype=jnp.float32) -> BlockedCSRFeatures:
+    """Partition a scipy.sparse matrix's nnz by column block (host-side
+    ingest for the feature-dim-sharded mode). Columns are implicitly
+    zero-padded to a multiple of ``num_blocks``."""
+    coo = mat.tocoo()
+    n_rows, d = coo.shape
+    block = -(-d // num_blocks)  # ceil
+    owner = coo.col // block
+    # Vectorized routing: stable-sort nnz by owner, then each block's
+    # entries are a contiguous run placed at consecutive slots
+    # (position-within-run via the shared _ell_pack helper).
+    order = np.argsort(owner, kind="stable")
+    o_sorted = owner[order]
+    slot, m = _ell_pack(o_sorted, num_blocks)
+    values = np.zeros((num_blocks, m), dtype=np.float64)
+    col_local = np.zeros((num_blocks, m), dtype=np.int32)
+    row_ids = np.zeros((num_blocks, m), dtype=np.int32)
+    values[o_sorted, slot] = coo.data[order]
+    col_local[o_sorted, slot] = coo.col[order] - o_sorted * block
+    row_ids[o_sorted, slot] = coo.row[order]
+    return BlockedCSRFeatures(
+        values=jnp.asarray(values, dtype),
+        col_local=jnp.asarray(col_local),
+        row_ids=jnp.asarray(row_ids),
+        n_rows=int(n_rows),
+        n_features=int(num_blocks * block),
+        block_size=int(block),
+    )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class BlockedEllFeatures:
+    """Dual ELLPACK sparse layout, partitioned into column blocks — the
+    TPU-FAST sparse layout: BOTH products are gather + fixed-width
+    reductions, with NO scatter anywhere.
+
+    Motivation (measured, TPU v5e via this repo's bench): XLA's
+    scatter-add (`segment_sum`) runs at ~120M updates/s regardless of
+    index sortedness, while gathers stream at GB/s — a scatter-based CSR
+    transpose product is ~100x off the roofline. ELLPACK turns the
+    transpose product into the same gather shape as the forward product by
+    keeping a second, column-major copy of the nnz:
+
+    - row-major: ``vals_r[kb, n, kr]`` + in-block column ids
+      ``col_local_r`` — matvec gathers the block's coefficient slice and
+      sums over the fixed kr axis; block partials sum (psum when the
+      leading axis is sharded).
+    - col-major: ``vals_c[kb, block, kc]`` + row ids ``row_ids_c`` —
+      rmatvec gathers the (replicated) residual vector and sums over kc,
+      landing directly in the block's own coefficient slice.
+
+    Padding entries carry value 0 and index 0. Padding waste is bounded by
+    the max row/column degree within a block; heavy-tailed degree
+    distributions should bucket columns by degree before blocking (same
+    recipe as the random-effect size buckets).
+    """
+
+    vals_r: Array  # f[kb, n, kr]
+    col_local_r: Array  # i32[kb, n, kr]
+    vals_c: Array  # f[kb, block, kc]
+    row_ids_c: Array  # i32[kb, block, kc]
+    n_rows: int
+    n_features: int  # padded: kb * block_size
+    block_size: int
+
+    @property
+    def num_blocks(self) -> int:
+        return self.vals_r.shape[0]
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.n_features)
+
+    @property
+    def num_features(self) -> int:
+        return self.n_features
+
+    def _gather_coef(self, v: Array) -> Array:
+        """[kb, n, kr] coefficient gather. A single flat gather with
+        per-block offsets folded into the indices — a vmapped/batched
+        gather lowers ~9x slower on TPU (measured: 95 ms vs 10.7 ms for
+        12M lookups)."""
+        offs = (jnp.arange(self.num_blocks, dtype=self.col_local_r.dtype)
+                * self.block_size)[:, None, None]
+        return v[self.col_local_r + offs]
+
+    # Single-block (single-device) calls strip the leading block axis:
+    # a unit batch dim makes the gather+multiply+axis-reduce lower 4-6x
+    # slower on TPU (measured: 87 ms vs 15 ms matvec, 324 ms vs 77 ms
+    # rmatvec at 12M nnz). The multi-block 3-D form is kept for the
+    # mesh-sharded path, where the leading axis is the sharding axis.
+
+    def matvec(self, v: Array) -> Array:
+        if self.num_blocks == 1:
+            gath = v[self.col_local_r[0]]  # [n, kr]
+            return jnp.sum(self.vals_r[0] * gath, axis=-1)
+        gath = self._gather_coef(v)  # [kb, n, kr]
+        return jnp.einsum("bnk,bnk->n", self.vals_r, gath)
+
+    def rmatvec(self, u: Array) -> Array:
+        if self.num_blocks == 1:
+            gath = u[self.row_ids_c[0]]  # [block, kc]
+            return jnp.sum(self.vals_c[0] * gath, axis=-1)
+        gath = u[self.row_ids_c]  # [kb, block, kc]
+        return jnp.einsum("bck,bck->bc", self.vals_c, gath).reshape(-1)
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        if self.num_blocks == 1:
+            gath = v[self.col_local_r[0]]
+            return jnp.sum(self.vals_r[0] * self.vals_r[0] * gath, axis=-1)
+        gath = self._gather_coef(v)
+        return jnp.einsum("bnk,bnk,bnk->n", self.vals_r, self.vals_r, gath)
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        if self.num_blocks == 1:
+            gath = u[self.row_ids_c[0]]
+            return jnp.sum(self.vals_c[0] * self.vals_c[0] * gath, axis=-1)
+        gath = u[self.row_ids_c]
+        return jnp.einsum("bck,bck,bck->bc", self.vals_c, self.vals_c,
+                          gath).reshape(-1)
+
+    def tree_flatten(self):
+        return (self.vals_r, self.col_local_r, self.vals_c,
+                self.row_ids_c), (self.n_rows, self.n_features,
+                                  self.block_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+def _ell_pack(ids: np.ndarray, minlength: int):
+    """For sorted ids, return (position-within-run, max run length)."""
+    counts = np.bincount(ids, minlength=minlength)
+    width = int(counts.max()) if len(ids) else 1
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(ids)) - np.repeat(starts, counts)
+    return pos, max(width, 1)
+
+
+def blocked_ell_from_arrays(rows, cols, vals, n_rows: int, n_cols: int,
+                            num_blocks: int = 1,
+                            dtype=jnp.float32) -> BlockedEllFeatures:
+    """Build the dual-ELL layout from COO triplets (host-side ingest)."""
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    vals = np.asarray(vals)
+    block = -(-n_cols // num_blocks)
+    owner = cols // block
+    col_local = (cols - owner * block).astype(np.int64)
+
+    # Row-major copy: sort by (owner, row), place at per-run positions.
+    order_r = np.lexsort((rows, owner))
+    run_ids = owner[order_r] * n_rows + rows[order_r]
+    pos_r, kr = _ell_pack(run_ids, num_blocks * n_rows)
+    vals_r = np.zeros((num_blocks, n_rows, kr), vals.dtype)
+    col_r = np.zeros((num_blocks, n_rows, kr), np.int32)
+    vals_r[owner[order_r], rows[order_r], pos_r] = vals[order_r]
+    col_r[owner[order_r], rows[order_r], pos_r] = col_local[order_r]
+
+    # Col-major copy: sort by global column, place at per-run positions.
+    order_c = np.argsort(cols, kind="stable")
+    pos_c, kc = _ell_pack(cols[order_c], num_blocks * block)
+    vals_c = np.zeros((num_blocks, block, kc), vals.dtype)
+    row_c = np.zeros((num_blocks, block, kc), np.int32)
+    vals_c[owner[order_c], col_local[order_c], pos_c] = vals[order_c]
+    row_c[owner[order_c], col_local[order_c], pos_c] = rows[order_c]
+
+    return BlockedEllFeatures(
+        vals_r=jnp.asarray(vals_r, dtype),
+        col_local_r=jnp.asarray(col_r),
+        vals_c=jnp.asarray(vals_c, dtype),
+        row_ids_c=jnp.asarray(row_c),
+        n_rows=int(n_rows),
+        n_features=int(num_blocks * block),
+        block_size=int(block),
+    )
+
+
+def blocked_ell_from_scipy(mat, num_blocks: int = 1,
+                           dtype=jnp.float32) -> BlockedEllFeatures:
+    coo = mat.tocoo()
+    return blocked_ell_from_arrays(coo.row, coo.col, coo.data,
+                                   coo.shape[0], coo.shape[1],
+                                   num_blocks=num_blocks, dtype=dtype)
+
+
+FeatureMatrix = Union[DenseFeatures, CSRFeatures, BlockedCSRFeatures,
+                      BlockedEllFeatures, KroneckerFeatures]
 
 
 def csr_from_scipy(mat, n_features: int | None = None, pad_to: int | None = None,

@@ -11,6 +11,7 @@ from photon_ml_tpu.parallel.distributed import (
     make_mesh_2d,
     replicate,
     shard_batch,
+    shard_batch_csr_feature_dim,
     shard_batch_feature_dim,
     shard_block,
     shard_coef,
@@ -24,6 +25,7 @@ __all__ = [
     "make_mesh_2d",
     "replicate",
     "shard_batch",
+    "shard_batch_csr_feature_dim",
     "shard_batch_feature_dim",
     "shard_block",
     "shard_coef",

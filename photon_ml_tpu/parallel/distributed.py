@@ -32,7 +32,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.data.random_effect import EntityBlock
-from photon_ml_tpu.ops.features import CSRFeatures, DenseFeatures
+from photon_ml_tpu.ops.features import (
+    BlockedCSRFeatures,
+    BlockedEllFeatures,
+    CSRFeatures,
+    DenseFeatures,
+)
 from photon_ml_tpu.ops.glm_objective import GLMBatch
 
 Array = jax.Array
@@ -152,11 +157,16 @@ def shard_batch_feature_dim(
     columns simultaneously; rows are padded with weight-0 rows.
     """
     feats = batch.features
+    if isinstance(feats, (CSRFeatures, BlockedCSRFeatures,
+                          BlockedEllFeatures)):
+        # Sparse huge-d regime: route through the column-blocked sparse
+        # layouts instead of densifying.
+        return shard_batch_csr_feature_dim(batch, mesh, col_axis=col_axis,
+                                           row_axis=row_axis)
     if not isinstance(feats, DenseFeatures):
         raise TypeError(
-            "feature-dimension sharding requires a dense layout; convert "
-            "CSR shards with .to_dense() first (the d-beyond-HBM regime is "
-            "dense-blocked on TPU)")
+            f"unsupported feature type {type(feats)} for feature-dimension "
+            "sharding")
     kc = mesh.shape[col_axis]
     x = _pad_to_multiple(feats.x, kc, 1, 0.0)
     labels, offsets, weights = batch.labels, batch.offsets, batch.weights
@@ -174,6 +184,78 @@ def shard_batch_feature_dim(
         labels=jax.device_put(labels, row_sh),
         offsets=jax.device_put(offsets, row_sh),
         weights=jax.device_put(weights, row_sh),
+    )
+
+
+def shard_batch_csr_feature_dim(
+    batch: GLMBatch,
+    mesh: Mesh,
+    col_axis: str = DATA_AXIS,
+    row_axis: Optional[str] = None,
+) -> GLMBatch:
+    """Feature-dimension sharding for SPARSE features: nnz entries are
+    partitioned into per-device column blocks (BlockedCSRFeatures) whose
+    leading block axis shards over ``col_axis``. Margins compile to
+    per-device partial segment-sums + an ICI psum over the block axis;
+    the gradient scatter stays entirely local to each device's coefficient
+    slice. This is the layout for the reference's "hundreds of billions of
+    coefficients" sparse regime (README §GAME), where densifying X is
+    impossible — only the nnz stream and the sharded coefficient vector
+    ever exist in HBM.
+
+    The nnz stream cannot shard over rows simultaneously (entries are
+    routed by column), so ``row_axis`` must be None; n-vectors replicate.
+    """
+    from photon_ml_tpu.ops.features import blocked_csr_from_scipy
+
+    if row_axis is not None:
+        raise ValueError(
+            "CSR feature-dim sharding routes nnz by column; a 2-D "
+            "(row x col) layout is only available for dense features")
+    kc = mesh.shape[col_axis]
+    feats = batch.features
+    if isinstance(feats, CSRFeatures):
+        import scipy.sparse as sp
+
+        host = sp.coo_matrix(
+            (np.asarray(feats.values), (np.asarray(feats.row_ids),
+                                        np.asarray(feats.col_ids))),
+            shape=feats.shape)
+        feats = blocked_csr_from_scipy(host, kc,
+                                       dtype=feats.values.dtype)
+    if not isinstance(feats, (BlockedCSRFeatures, BlockedEllFeatures)):
+        raise TypeError(f"expected CSR/ELL features, got {type(feats)}")
+    if feats.num_blocks != kc:
+        raise ValueError(
+            f"features have {feats.num_blocks} column blocks, mesh axis "
+            f"{col_axis!r} has {kc} devices — rebuild with num_blocks={kc}")
+    rep = NamedSharding(mesh, P())
+    if isinstance(feats, BlockedEllFeatures):
+        blk3 = NamedSharding(mesh, P(col_axis, None, None))
+        new_feats = BlockedEllFeatures(
+            vals_r=jax.device_put(feats.vals_r, blk3),
+            col_local_r=jax.device_put(feats.col_local_r, blk3),
+            vals_c=jax.device_put(feats.vals_c, blk3),
+            row_ids_c=jax.device_put(feats.row_ids_c, blk3),
+            n_rows=feats.n_rows,
+            n_features=feats.n_features,
+            block_size=feats.block_size,
+        )
+    else:
+        blk_sh = NamedSharding(mesh, P(col_axis, None))
+        new_feats = BlockedCSRFeatures(
+            values=jax.device_put(feats.values, blk_sh),
+            col_local=jax.device_put(feats.col_local, blk_sh),
+            row_ids=jax.device_put(feats.row_ids, blk_sh),
+            n_rows=feats.n_rows,
+            n_features=feats.n_features,
+            block_size=feats.block_size,
+        )
+    return GLMBatch(
+        features=new_feats,
+        labels=jax.device_put(batch.labels, rep),
+        offsets=jax.device_put(batch.offsets, rep),
+        weights=jax.device_put(batch.weights, rep),
     )
 
 

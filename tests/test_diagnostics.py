@@ -3,6 +3,7 @@ statistics checked against closed forms / scipy; driver wiring checked
 end-to-end on tiny synthetic data)."""
 
 import json
+import re
 
 import numpy as np
 import pytest
@@ -223,5 +224,14 @@ def test_glm_driver_diagnostic_mode(tmp_path, rng):
     assert "predictionErrorIndependence" in model
     assert "fitting" in model and "bootstrap" in model
     assert (out / "model-diagnostic.html").exists()
+    # Every table the reference renders as an xchart plot
+    # (ml/diagnostics/reporting/html/) gets an inline-SVG chart: feature
+    # importance, learning curves, bootstrap CIs, HL calibration.
+    report_html = (out / "model-diagnostic.html").read_text()
+    assert report_html.count("<svg") >= 4, report_html.count("<svg")
+    import xml.etree.ElementTree as ET
+
+    for svg in re.findall(r"<svg.*?</svg>", report_html, re.S):
+        ET.fromstring(svg)  # well-formed
     summary = json.loads((out / "summary.json").read_text())
     assert "DIAGNOSED" in summary["stages"]

@@ -109,6 +109,62 @@ def test_factored_coordinate_learns_low_rank_structure(rng):
     np.testing.assert_allclose(s1, g, rtol=1e-3, atol=1e-4)
 
 
+def test_factored_model_persists_latent_artifacts(rng, tmp_path):
+    """Saving a GAME model with a factored coordinate writes BOTH the
+    converted original-space coefficients (the reference's on-disk form)
+    AND the latent decomposition (per-entity gamma + projection B as
+    LatentFactorAvro, the schema of ModelProcessingUtils.scala:400-424),
+    with the MF config recorded in model-metadata.json."""
+    import json
+
+    from photon_ml_tpu.io.avro_codec import read_container
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+    from photon_ml_tpu.models.game_model import GameModel
+    from photon_ml_tpu.data.index_map import IdentityIndexMap
+
+    data, ds, y = _low_rank_fixture(rng)
+    l2 = RegularizationContext(RegularizationType.L2)
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=20, tolerance=1e-8, regularization_weight=1e-3,
+        regularization_context=l2)
+    coord = FactoredRandomEffectCoordinate(
+        name="perUserMF", dataset=ds, task_type=TaskType.LINEAR_REGRESSION,
+        config=cfg, latent_config=cfg,
+        mf_config=MFOptimizationConfiguration(max_iterations=2,
+                                              num_factors=2))
+    model, _ = coord.update_model(coord.initialize_model(), None,
+                                  jax.random.key(0))
+    gm = GameModel({"perUserMF": model}, TaskType.LINEAR_REGRESSION)
+    imap = IdentityIndexMap(ds.num_global_features)
+    save_game_model(tmp_path, gm, {model.feature_shard_id: imap})
+
+    latent_dir = tmp_path / "random-effect" / "perUserMF" / "latent"
+    gammas = list(read_container(latent_dir / "gamma-latent-factors.avro"))
+    proj = list(read_container(
+        latent_dir / "projection-latent-factors.avro"))
+    assert len(gammas) == model.num_entities
+    assert all(len(r["latentFactor"]) == 2 for r in gammas)
+    assert len(proj) == 2
+    assert all(len(r["latentFactor"]) == ds.num_global_features
+               for r in proj)
+    # gamma^T B reconstructs each entity's saved original-space row.
+    by_id = {r["effectId"]: np.asarray(r["latentFactor"]) for r in gammas}
+    b = np.asarray([r["latentFactor"] for r in proj])
+    entity_rows = model.to_entity_dict()
+    for name, (cols, vals) in list(entity_rows.items())[:5]:
+        dense = np.zeros(ds.num_global_features)
+        dense[cols] = vals
+        np.testing.assert_allclose(by_id[name] @ b, dense, atol=1e-5)
+
+    meta = json.loads((tmp_path / "model-metadata.json").read_text())
+    (coord_meta,) = meta["coordinates"]
+    assert coord_meta["factored"] == {"numFactors": 2, "mfMaxIterations": 2}
+    # Loads back as a plain random-effect model (reference behavior).
+    loaded = load_game_model(tmp_path,
+                             {model.feature_shard_id: imap})
+    assert "perUserMF" in loaded.models
+
+
 def test_factored_coordinate_requires_identity_blocks(rng):
     data, _, _ = _low_rank_fixture(rng, n=60, d=6, n_users=4)
     ds = build_random_effect_dataset(
