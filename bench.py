@@ -265,7 +265,9 @@ def owlqn_iter_ms():
     x = rng.normal(0, 1, (N_ROWS, D_FIXED)).astype(np.float32)
     x *= np.logspace(0, 2, D_FIXED)[None, :].astype(np.float32)
     w = rng.normal(0, 0.3, D_FIXED) / np.logspace(0, 2, D_FIXED)
-    y = np.sign(x @ w + rng.normal(0, 0.3, N_ROWS)).astype(np.float32)
+    # labels in {0, 1} (losses.py maps to the ±1 margin convention)
+    y = ((np.sign(x @ w + rng.normal(0, 0.3, N_ROWS)) + 1) / 2
+         ).astype(np.float32)
     batch = make_batch(DenseFeatures(jnp.asarray(x)), jnp.asarray(y))
     obj = GLMObjective(
         loss_for_task(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM))

@@ -13,7 +13,6 @@ from pathlib import Path
 import numpy as np
 
 from photon_ml_tpu.data.avro_reader import read_game_dataset
-from photon_ml_tpu.data.index_map import IndexMap
 from photon_ml_tpu.evaluation import build_evaluator
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import write_container
@@ -32,7 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--game-model-input-dir", required=True)
     p.add_argument("--output-dir", required=True)
     p.add_argument("--feature-index-dir", default=None,
-                   help="directory of <shard>.json index maps (defaults to "
+                   help="feature index stores keyed by shard id: "
+                        "<shard>.json maps or the reference's partitioned "
+                        "PalDB stores (defaults to "
                         "<model-dir>/feature-indexes)")
     p.add_argument("--evaluators", default=None)
     p.add_argument("--id-types", default=None)
@@ -46,13 +47,12 @@ def run(argv=None) -> dict:
     logger = setup_photon_logger(out_dir)
     t0 = time.perf_counter()
 
+    from photon_ml_tpu.data.paldb import load_feature_index_maps
+
     model_dir = Path(args.game_model_input_dir)
     index_dir = Path(args.feature_index_dir) if args.feature_index_dir else \
         model_dir / "feature-indexes"
-    shard_maps = {
-        f.stem: IndexMap.load(f) for f in sorted(index_dir.glob("*.json"))}
-    if not shard_maps:
-        raise FileNotFoundError(f"no feature index maps under {index_dir}")
+    shard_maps = load_feature_index_maps(index_dir)
     model = load_game_model(model_dir, shard_maps)
 
     meta = json.loads((model_dir / "model-metadata.json").read_text())

@@ -299,7 +299,7 @@ def blocked_csr_from_scipy(mat, num_blocks: int,
     order = np.argsort(owner, kind="stable")
     o_sorted = owner[order]
     slot, m = _ell_pack(o_sorted, num_blocks)
-    values = np.zeros((num_blocks, m), dtype=np.float64)
+    values = np.zeros((num_blocks, m), dtype=coo.data.dtype)
     col_local = np.zeros((num_blocks, m), dtype=np.int32)
     row_ids = np.zeros((num_blocks, m), dtype=np.int32)
     values[o_sorted, slot] = coo.data[order]
@@ -368,9 +368,14 @@ class BlockedEllFeatures:
         per-block offsets folded into the indices — a vmapped/batched
         gather lowers ~9x slower on TPU (measured: 95 ms vs 10.7 ms for
         12M lookups)."""
-        offs = (jnp.arange(self.num_blocks, dtype=self.col_local_r.dtype)
+        # Index arithmetic must not wrap: beyond 2^31 coefficients the
+        # i32 block offsets overflow, so promote to i64 (n_features is
+        # static, so the choice costs nothing below the threshold).
+        idx_dtype = (jnp.int64 if self.n_features > np.iinfo(np.int32).max
+                     else self.col_local_r.dtype)
+        offs = (jnp.arange(self.num_blocks, dtype=idx_dtype)
                 * self.block_size)[:, None, None]
-        return v[self.col_local_r + offs]
+        return v[self.col_local_r.astype(idx_dtype) + offs]
 
     # Single-block (single-device) calls strip the leading block axis:
     # a unit batch dim makes the gather+multiply+axis-reduce lower 4-6x
