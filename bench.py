@@ -245,7 +245,8 @@ def tron_iter_ms():
 
     def solve(mi):
         return minimize_tron(obj.value, x0, args=(batch, 1.0),
-                             max_iter=mi, tol=0.0)
+                             max_iter=mi, tol=0.0,
+                             make_hvp=obj.make_tron_hvp)
 
     return _marginal_iter_ms(solve, lo=5, hi=15)
 

@@ -91,6 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=[t.value for t in NormalizationType])
     p.add_argument("--coefficient-box-constraints", default=None,
                    help="JSON constraint string (GLMSuite format)")
+    p.add_argument("--offheap-indexmap-dir", default=None,
+                   help="pre-built feature index stores (the reference's "
+                        "partitioned PalDB paldb-partition-<ns>-<N>.dat "
+                        "stores, OptionNames.OFFHEAP_INDEXMAP_DIR, or this "
+                        "package's <ns>.json) — skips the Avro index scan; "
+                        "uses the 'global' namespace, or the only one "
+                        "present")
+    p.add_argument("--offheap-indexmap-namespace", default=None,
+                   help="store namespace to use when the directory holds "
+                        "several (defaults to 'global' or the only one)")
     p.add_argument("--selected-features-file", default=None,
                    help="Avro file of name/term records restricting the "
                         "feature set (GLMSuite selectedFeaturesFile)")
@@ -323,9 +333,50 @@ def run(argv=None) -> dict:
     with timer.time("preprocess"):
         selected = (_read_selected_features(args.selected_features_file)
                     if args.selected_features_file else None)
+        preloaded_map = None
+        if args.offheap_indexmap_dir:
+            if args.format != "AVRO":
+                raise ValueError(
+                    "--offheap-indexmap-dir requires --format AVRO")
+            from photon_ml_tpu.data.paldb import (
+                discover_namespaces,
+                load_paldb_index_map,
+            )
+
+            store_dir = Path(args.offheap_indexmap_dir)
+            try:
+                namespaces = discover_namespaces(store_dir)
+            except FileNotFoundError:
+                namespaces = {p.stem: 0
+                              for p in sorted(store_dir.glob("*.json"))}
+                if not namespaces:
+                    raise
+            ns = args.offheap_indexmap_namespace or (
+                "global" if "global" in namespaces
+                else next(iter(namespaces)) if len(namespaces) == 1
+                else None)
+            if ns is None or ns not in namespaces:
+                raise ValueError(
+                    f"--offheap-indexmap-dir holds namespaces "
+                    f"{sorted(namespaces)}; pick one with "
+                    "--offheap-indexmap-namespace")
+            # Parse only the selected namespace (a dir can hold several
+            # multi-million-feature shards).
+            if namespaces[ns]:
+                preloaded_map = load_paldb_index_map(
+                    store_dir, ns, namespaces[ns])
+            else:
+                preloaded_map = IndexMap.load(store_dir / f"{ns}.json")
+            if add_intercept and preloaded_map.intercept_index < 0:
+                raise ValueError(
+                    f"feature index store {ns!r} has no intercept key but "
+                    "--intercept is true — rebuild the store with an "
+                    "intercept or pass --intercept false")
+            logger.info("loaded feature index store %r (%d features) "
+                        "from %s", ns, len(preloaded_map), store_dir)
         mat, y, off, w, imap = _load(
             args.training_data_directory, args.format, add_intercept, task,
-            selected_features=selected)
+            index_map=preloaded_map, selected_features=selected)
         logger.info("loaded %d rows x %d features", *mat.shape)
         validate_data(task, mat, y, off, w,
                       DataValidationType(args.validate_data))

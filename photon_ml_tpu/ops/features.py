@@ -370,9 +370,18 @@ class BlockedEllFeatures:
         12M lookups)."""
         # Index arithmetic must not wrap: beyond 2^31 coefficients the
         # i32 block offsets overflow, so promote to i64 (n_features is
-        # static, so the choice costs nothing below the threshold).
-        idx_dtype = (jnp.int64 if self.n_features > np.iinfo(np.int32).max
-                     else self.col_local_r.dtype)
+        # static, so the choice costs nothing below the threshold). With
+        # jax_enable_x64 off, an int64 request silently downgrades to
+        # int32 — fail loudly rather than gather from wrapped indices.
+        if self.n_features > np.iinfo(np.int32).max:
+            if not jax.config.jax_enable_x64:
+                raise ValueError(
+                    f"n_features={self.n_features} needs int64 gather "
+                    "indices; enable jax_enable_x64 (or shard into more "
+                    "column blocks)")
+            idx_dtype = jnp.int64
+        else:
+            idx_dtype = self.col_local_r.dtype
         offs = (jnp.arange(self.num_blocks, dtype=idx_dtype)
                 * self.block_size)[:, None, None]
         return v[self.col_local_r.astype(idx_dtype) + offs]

@@ -149,15 +149,10 @@ class GLMObjective:
         return (jnp.sum(batch.weights * self.loss.loss(z, batch.labels))
                 + 0.5 * l2_weight * coef_sq_norm)
 
-    def gradient_from_margins(
-        self, coef: Array, z: Array, batch: GLMBatch,
-        l2_weight: Array | float = 0.0,
-    ) -> Array:
-        """Gradient given precomputed margins: one feature contraction
-        (X^T u) instead of the matvec+rmatvec pair jax.grad(value) issues.
-        The normalization chain rule mirrors the reference's hand-coded
-        factor/shift algebra (ValueAndGradientAggregator.scala:133-154)."""
-        u = batch.weights * self.loss.d1(z, batch.labels)
+    def _jt_product(self, u: Array, batch: GLMBatch) -> Array:
+        """J^T u where J = dz/dcoef — the normalization chain rule shared
+        by the gradient and the margin-cached Hessian-vector product
+        (mirrors ValueAndGradientAggregator.scala:133-154)."""
         r = batch.features.rmatvec(u)
         norm = self.normalization
         if norm is not None:
@@ -165,7 +160,45 @@ class GLMObjective:
                 r = r - jnp.sum(u) * norm.shifts
             if norm.factors is not None:
                 r = r * norm.factors
-        return r + l2_weight * coef
+        return r
+
+    def gradient_from_margins(
+        self, coef: Array, z: Array, batch: GLMBatch,
+        l2_weight: Array | float = 0.0,
+    ) -> Array:
+        """Gradient given precomputed margins: one feature contraction
+        (X^T u) instead of the matvec+rmatvec pair jax.grad(value) issues."""
+        u = batch.weights * self.loss.d1(z, batch.labels)
+        return self._jt_product(u, batch) + l2_weight * coef
+
+    def curvature_from_margins(self, z: Array, batch: GLMBatch) -> Array:
+        """d2_i = w_i l''(z_i, y_i) — the Gauss-Newton curvature weights,
+        computed ONCE per outer TRON iteration and reused by every inner
+        CG Hessian-vector product (the reference recomputes the margin
+        pass inside each HessianVectorAggregator treeAggregate)."""
+        return batch.weights * self.loss.d2(z, batch.labels)
+
+    def hessian_vector_from_margins(
+        self, vec: Array, d2: Array, batch: GLMBatch,
+        l2_weight: Array | float = 0.0,
+    ) -> Array:
+        """H @ vec with precomputed curvature weights: exactly one
+        matvec + one rmatvec (J v is affine: margin_direction), vs the
+        ~2x cost of jvp-of-grad which also re-derives the margin pass."""
+        jv = self.margin_direction(vec, batch)
+        return self._jt_product(d2 * jv, batch) + l2_weight * vec
+
+    def make_tron_hvp(self, x: Array, batch: GLMBatch,
+                      l2_weight: Array | float = 0.0):
+        """Hessian-vector factory for minimize_tron's ``make_hvp`` hook:
+        margins + curvature computed once per outer iteration, each inner
+        CG product costs one matvec + one rmatvec. (Bound methods hash by
+        (instance, function), so this is a stable jit static argument for
+        a persistent objective.)"""
+        z = self.margins(x, batch)
+        d2 = self.curvature_from_margins(z, batch)
+        return lambda v: self.hessian_vector_from_margins(
+            v, d2, batch, l2_weight)
 
     # -- second-order -----------------------------------------------------
 

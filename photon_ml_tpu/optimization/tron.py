@@ -117,11 +117,12 @@ class _TronState(NamedTuple):
     jax.jit,
     static_argnames=("fun", "max_iter", "tol", "max_cg",
                      "max_improvement_failures", "has_bounds",
-                     "track_coefficients"),
+                     "track_coefficients", "make_hvp"),
 )
 def _minimize_tron_impl(
     fun, x0, args, lower, upper, *, max_iter, tol, max_cg,
     max_improvement_failures, has_bounds, track_coefficients=False,
+    make_hvp=None,
 ) -> OptimizerResult:
     vg = jax.value_and_grad(fun)
     dtype = x0.dtype
@@ -160,9 +161,15 @@ def _minimize_tron_impl(
         return st.reason == int(ConvergenceReason.NOT_CONVERGED)
 
     def body(st: _TronState):
-        def hvp(v):
-            grad_fn = lambda xx: vg(xx, *args)[1]
-            return jax.jvp(grad_fn, (st.x,), (v,))[1]
+        if make_hvp is not None:
+            # Caller-specialized product (GLM: margin-cached, exactly one
+            # matvec+rmatvec per CG step; curvature weights computed once
+            # per outer iteration and hoisted out of the CG loop).
+            hvp = make_hvp(st.x, *args)
+        else:
+            def hvp(v):
+                grad_fn = lambda xx: vg(xx, *args)[1]
+                return jax.jvp(grad_fn, (st.x,), (v,))[1]
 
         if has_bounds:
             # Active-set reduction: coordinates pinned at a bound with the
@@ -284,11 +291,18 @@ def minimize_tron(
     lower_bounds: Optional[Array] = None,
     upper_bounds: Optional[Array] = None,
     track_coefficients: bool = False,
+    make_hvp: Optional[Callable] = None,
 ) -> OptimizerResult:
     """Minimize twice-differentiable ``fun(x, *args)`` from ``x0``.
 
     Defaults mirror the reference (maxIter=15, tol=1e-5, <=20 CG iterations,
     <=5 improvement failures; ml/optimization/TRON.scala:258-264).
+
+    ``make_hvp(x, *args) -> (v -> H v)``: optional specialized
+    Hessian-vector factory, called once per outer iteration (its
+    closed-over precomputations hoist out of the inner CG loop). Defaults
+    to jvp-of-grad. Must be a STABLE callable (hashed as a static jit
+    argument).
     """
     x0 = jnp.asarray(x0)
     dtype = x0.dtype
@@ -302,4 +316,5 @@ def minimize_tron(
         fun, x0, args, lo, hi, max_iter=max_iter, tol=tol, max_cg=max_cg,
         max_improvement_failures=max_improvement_failures,
         has_bounds=has_bounds, track_coefficients=track_coefficients,
+        make_hvp=make_hvp,
     )

@@ -63,6 +63,47 @@ def test_hessian_vector_and_diagonal_match_dense_hessian(rng):
                                rtol=gold(1e-9))
 
 
+def test_margin_cached_hessian_vector_matches_jvp(rng):
+    """hessian_vector_from_margins (one matvec+rmatvec, TRON's CG hot op)
+    == the jvp-of-grad product, with and without normalization."""
+    from photon_ml_tpu.data.normalization import NormalizationContext
+
+    x, y, w, off, coef = _problem(rng, n=40, d=6)
+    v = jnp.asarray(rng.normal(0, 1, 6))
+    l2 = 0.3
+    for norm in (None, NormalizationContext(
+            factors=jnp.asarray(rng.uniform(0.5, 2.0, 6)),
+            shifts=jnp.asarray(rng.normal(0, 1, 6)))):
+        obj = GLMObjective(LogisticLoss, normalization=norm)
+        batch = make_batch(DenseFeatures(jnp.asarray(x)), y, off, w)
+        ref = obj.hessian_vector(jnp.asarray(coef), v, batch, l2)
+        z = obj.margins(jnp.asarray(coef), batch)
+        d2 = obj.curvature_from_margins(z, batch)
+        fast = obj.hessian_vector_from_margins(v, d2, batch, l2)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
+                                   rtol=gold(1e-9))
+        # The TRON factory produces the same product.
+        hvp = obj.make_tron_hvp(jnp.asarray(coef), batch, l2)
+        np.testing.assert_allclose(np.asarray(hvp(v)), np.asarray(ref),
+                                   rtol=gold(1e-9))
+
+
+def test_tron_with_margin_cached_hvp_matches_generic(rng):
+    from photon_ml_tpu.optimization import minimize_tron
+
+    x, y, w, off, coef = _problem(rng, n=60, d=5)
+    obj = GLMObjective(LogisticLoss)
+    batch = make_batch(DenseFeatures(jnp.asarray(x)), y, off, w)
+    fun = obj.value
+    r1 = minimize_tron(fun, jnp.zeros(5), args=(batch, 0.5), tol=1e-10)
+    r2 = minimize_tron(fun, jnp.zeros(5), args=(batch, 0.5), tol=1e-10,
+                       make_hvp=obj.make_tron_hvp)
+    np.testing.assert_allclose(float(r2.value), float(r1.value),
+                               rtol=gold(1e-10))
+    np.testing.assert_allclose(np.asarray(r2.x), np.asarray(r1.x),
+                               atol=gold(1e-8, f32_floor=1e-3))
+
+
 def test_normalization_algebra_equals_materialized(rng):
     """Training-space objective via factors/shifts == objective on explicitly
     normalized features (the reference's sparsity-preserving trick,

@@ -189,3 +189,46 @@ def test_training_driver_accepts_feature_index_dir(tmp_path):
     # The model was trained in the PalDB store's 31-feature index space.
     model_txt = list((tmp_path / "out" / "best").rglob("*.avro"))
     assert model_txt, "saved model artifacts missing"
+
+
+def test_glm_driver_accepts_offheap_indexmap_dir(tmp_path):
+    """--offheap-indexmap-dir (the reference's OFFHEAP_INDEXMAP_DIR flag)
+    trains a GLM in a reference PalDB store's index space."""
+    from photon_ml_tpu.cli.glm_driver import run as glm_run
+    from photon_ml_tpu.data.index_map import split_key
+    from photon_ml_tpu.data.paldb import load_paldb_index_map
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+
+    imap = load_paldb_index_map(GAME_INPUT / "feature-indexes", "shard3", 1)
+    keys = [k for k, _ in imap.key_items() if k != INTERCEPT_KEY][:6]
+    rng = np.random.default_rng(1)
+    records = []
+    for i in range(60):
+        feats = []
+        for k in rng.choice(len(keys), size=3, replace=False):
+            name, term = split_key(keys[int(k)])
+            feats.append({"name": name, "term": term,
+                          "value": float(rng.normal())})
+        records.append({"uid": f"u{i}", "label": float(rng.integers(0, 2)),
+                        "features": feats, "weight": None, "offset": None,
+                        "metadataMap": None})
+    data_dir = tmp_path / "train"
+    data_dir.mkdir()
+    write_container(data_dir / "part-0.avro", schemas.TRAINING_EXAMPLE,
+                    records)
+
+    out = glm_run([
+        "--training-data-directory", str(data_dir),
+        "--output-directory", str(tmp_path / "out"),
+        "--task", "LOGISTIC_REGRESSION",
+        "--offheap-indexmap-dir", str(GAME_INPUT / "feature-indexes"),
+        "--offheap-indexmap-namespace", "shard3",
+        "--regularization-weights", "1.0",
+        "--max-num-iterations", "15",
+    ])
+    assert out["numRows"] == 60
+    # The model text lists coefficients in the PalDB store's 31-feature
+    # index space (intercept included).
+    model_txt = (tmp_path / "out" / "best-model" / "model.txt").read_text()
+    assert "(INTERCEPT)" in model_txt
