@@ -339,18 +339,12 @@ def run(argv=None) -> dict:
                 raise ValueError(
                     "--offheap-indexmap-dir requires --format AVRO")
             from photon_ml_tpu.data.paldb import (
-                discover_namespaces,
-                load_paldb_index_map,
+                discover_store_namespaces,
+                load_store_namespace,
             )
 
             store_dir = Path(args.offheap_indexmap_dir)
-            try:
-                namespaces = discover_namespaces(store_dir)
-            except FileNotFoundError:
-                namespaces = {p.stem: 0
-                              for p in sorted(store_dir.glob("*.json"))}
-                if not namespaces:
-                    raise
+            namespaces = discover_store_namespaces(store_dir)
             ns = args.offheap_indexmap_namespace or (
                 "global" if "global" in namespaces
                 else next(iter(namespaces)) if len(namespaces) == 1
@@ -362,11 +356,8 @@ def run(argv=None) -> dict:
                     "--offheap-indexmap-namespace")
             # Parse only the selected namespace (a dir can hold several
             # multi-million-feature shards).
-            if namespaces[ns]:
-                preloaded_map = load_paldb_index_map(
-                    store_dir, ns, namespaces[ns])
-            else:
-                preloaded_map = IndexMap.load(store_dir / f"{ns}.json")
+            preloaded_map = load_store_namespace(store_dir, ns,
+                                                 namespaces[ns])
             if add_intercept and preloaded_map.intercept_index < 0:
                 raise ValueError(
                     f"feature index store {ns!r} has no intercept key but "

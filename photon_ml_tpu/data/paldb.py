@@ -228,17 +228,34 @@ def load_paldb_index_maps(directory) -> Dict[str, IndexMap]:
             for ns, parts in discover_namespaces(directory).items()}
 
 
+def discover_store_namespaces(directory) -> Dict[str, int]:
+    """namespace -> partition count for EITHER store format: the
+    reference's partitioned PalDB stores (count >= 1) or this package's
+    <ns>.json stores (count 0 marks the JSON format). The single place
+    that knows the on-disk naming conventions."""
+    directory = Path(directory)
+    if any(_STORE_RE.match(p.name) for p in directory.iterdir()):
+        return discover_namespaces(directory)
+    out = {p.stem: 0 for p in sorted(directory.glob("*.json"))}
+    if not out:
+        raise FileNotFoundError(
+            f"no paldb-partition-*.dat or *.json index stores in {directory}")
+    return out
+
+
+def load_store_namespace(directory, namespace: str,
+                         num_partitions: int) -> IndexMap:
+    """Load ONE namespace in either format (num_partitions from
+    :func:`discover_store_namespaces`; 0 = JSON)."""
+    if num_partitions:
+        return load_paldb_index_map(directory, namespace, num_partitions)
+    return IndexMap.load(Path(directory) / f"{namespace}.json")
+
+
 def load_feature_index_maps(directory) -> Dict[str, IndexMap]:
     """shard id -> IndexMap from a feature-index directory of EITHER
     format: the reference's partitioned PalDB stores
     (paldb-partition-<shard>-<i>.dat) or this package's JSON stores
     (<shard>.json, written by the training driver / feature-indexing CLI)."""
-    directory = Path(directory)
-    if any(_STORE_RE.match(p.name) for p in directory.iterdir()):
-        return load_paldb_index_maps(directory)
-    maps = {p.stem: IndexMap.load(p)
-            for p in sorted(directory.glob("*.json"))}
-    if not maps:
-        raise FileNotFoundError(
-            f"no paldb-partition-*.dat or *.json index stores in {directory}")
-    return maps
+    return {ns: load_store_namespace(directory, ns, parts)
+            for ns, parts in discover_store_namespaces(directory).items()}

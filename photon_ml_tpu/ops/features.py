@@ -524,7 +524,12 @@ def features_to_device(mat, dtype=jnp.float32,
                        dense_threshold: float = DENSE_DENSITY_THRESHOLD
                        ) -> FeatureMatrix:
     """Host feature matrix -> device layout, choosing dense vs CSR by
-    density. The single chooser shared by the GLM and GAME ingest paths."""
+    density. The single chooser shared by the GLM and GAME ingest paths.
+
+    For LARGE sparse problems (nnz beyond a few million) on TPU, build
+    ``blocked_ell_from_scipy`` explicitly instead: CSR's transpose product
+    is scatter-bound (~120M updates/s measured), while dual-ELL is
+    gather-only at ~2x the memory — see docs/SCALE.md."""
     import scipy.sparse as sp
 
     if sp.issparse(mat):

@@ -226,7 +226,7 @@ class GLMObjective:
         aggregations below so sparsity/batching is preserved.
         """
         z = self.margins(coef, batch)
-        d = batch.weights * self.loss.d2(z, batch.labels)
+        d = self.curvature_from_margins(z, batch)
         feats = batch.features
         sq_sum = feats.sq_rmatvec(d)  # sum d_i x_ij^2
         norm = self.normalization
