@@ -323,11 +323,12 @@ class BlockedEllFeatures:
     reductions, with NO scatter anywhere.
 
     Motivation (measured, TPU v5e via this repo's bench): XLA's
-    scatter-add (`segment_sum`) runs at ~120M updates/s regardless of
-    index sortedness, while gathers stream at GB/s — a scatter-based CSR
-    transpose product is ~100x off the roofline. ELLPACK turns the
-    transpose product into the same gather shape as the forward product by
-    keeping a second, column-major copy of the nnz:
+    scatter-add (`segment_sum`) runs at ~120M updates/s and gathers at
+    ~148M lookups/s — both flat (docs/SCALE.md) — and a scatter-based
+    CSR transpose product additionally pays sort/duplicate handling
+    (measured 6.7x slower end-to-end on the d=2M solve). ELLPACK turns
+    the transpose product into the same gather shape as the forward
+    product by keeping a second, column-major copy of the nnz:
 
     - row-major: ``vals_r[kb, n, kr]`` + in-block column ids
       ``col_local_r`` — matvec gathers the block's coefficient slice and
@@ -487,8 +488,204 @@ def blocked_ell_from_scipy(mat, num_blocks: int = 1,
                                    num_blocks=num_blocks, dtype=dtype)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class BucketedEllFeatures:
+    """Degree-bucketed dual ELLPACK — the single-device layout for LARGE
+    sparse problems (d in the millions), superseding the flat-width
+    ``BlockedEllFeatures`` when the degree distribution has any spread.
+
+    Measured law of this chip (TPU v5e, see docs/SCALE.md): random-access
+    lookups run at ~148M elem/s FLAT — independent of gather-table size
+    (1 MB or 8 MB), index count, index sortedness, and whether the gather
+    is issued as one op or many independent ops (XLA does not overlap
+    them). A sparse product's cost is therefore simply
+
+        time ≈ (stored slots) / 148M/s
+
+    so the ONLY lever is slot count. A flat ELL pads every row (column)
+    to the max degree; with a Poisson(6) degree distribution that is
+    3.3x the true nnz. This layout instead sorts rows/columns by degree,
+    partitions them into <= max_groups width classes (optimal split by
+    dynamic programming over the degree histogram), and pads only within
+    a class — slot count approaches nnz, and both products stay
+    gather + fixed-width-reduction with NO scatter:
+
+    - matvec: per row-group, gather w at the group's column ids and
+      reduce over the group width; concatenate group outputs (packed,
+      degree-sorted row order) and un-permute with one [n]-sized gather.
+    - rmatvec: symmetric on the column side, un-permute with one
+      [d]-sized gather.
+
+    The packed vector carries one extra zero slot at the end; rows
+    (columns) with degree 0 map there.
+    """
+
+    row_vals: Tuple[Array, ...]  # each f[nr_g, w_g]
+    row_cols: Tuple[Array, ...]  # each i32[nr_g, w_g] global col ids
+    row_inv: Array  # i32[n_rows] -> position in packed row outputs
+    col_vals: Tuple[Array, ...]  # each f[nc_g, w_g]
+    col_rows: Tuple[Array, ...]  # each i32[nc_g, w_g] row ids
+    col_inv: Array  # i32[n_features] -> position in packed col outputs
+    n_rows: int
+    n_features: int
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.n_features)
+
+    @property
+    def num_features(self) -> int:
+        return self.n_features
+
+    @property
+    def num_slots(self) -> int:
+        return (sum(v.size for v in self.row_vals)
+                + sum(v.size for v in self.col_vals))
+
+    @staticmethod
+    def _apply(vals, idx_arrays, table, inv, square: bool):
+        parts = []
+        for v, ix in zip(vals, idx_arrays):
+            g = table[ix]
+            parts.append(jnp.sum((v * v if square else v) * g, axis=-1))
+        parts.append(jnp.zeros((1,), table.dtype))  # degree-0 slot
+        packed = jnp.concatenate(parts)
+        return packed[inv]
+
+    def matvec(self, v: Array) -> Array:
+        return self._apply(self.row_vals, self.row_cols, v, self.row_inv,
+                           square=False)
+
+    def rmatvec(self, u: Array) -> Array:
+        return self._apply(self.col_vals, self.col_rows, u, self.col_inv,
+                           square=False)
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        return self._apply(self.row_vals, self.row_cols, v, self.row_inv,
+                           square=True)
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        return self._apply(self.col_vals, self.col_rows, u, self.col_inv,
+                           square=True)
+
+    def tree_flatten(self):
+        return ((self.row_vals, self.row_cols, self.row_inv,
+                 self.col_vals, self.col_rows, self.col_inv),
+                (self.n_rows, self.n_features))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+def _degree_groups(degrees: np.ndarray, max_groups: int):
+    """Partition degree-sorted entities into <= max_groups width classes
+    minimizing total padded slots: DP over the distinct-degree histogram
+    (group cost = member count x max degree in group). Returns a list of
+    (width, sorted_entity_ids) with width > 0, descending."""
+    nz = degrees > 0
+    if not nz.any():
+        return []
+    distinct, counts = np.unique(degrees[nz], return_counts=True)
+    distinct, counts = distinct[::-1], counts[::-1]  # descending degree
+    k = len(distinct)
+    if k > 512:  # compress the DP to candidate boundaries by mass
+        keep = np.unique(np.concatenate(
+            [[0, k - 1], np.searchsorted(
+                np.cumsum(counts), np.linspace(0, counts.sum(), 511))]))
+        keep = keep[keep < k]
+        merged_counts = np.add.reduceat(counts, keep)
+        distinct, counts = distinct[keep], merged_counts
+        k = len(distinct)
+    g = min(max_groups, k)
+    csum = np.concatenate([[0], np.cumsum(counts)])
+    inf = np.inf
+    cost = np.full((g + 1, k + 1), inf)
+    back = np.zeros((g + 1, k + 1), np.int64)
+    cost[0, 0] = 0.0
+    for gi in range(1, g + 1):
+        for j in range(1, k + 1):
+            # group covers distinct[i..j), width = distinct[i]
+            prev = cost[gi - 1, :j]
+            cand = prev + (csum[j] - csum[:j]) * distinct[:j]
+            i = int(np.argmin(cand))
+            cost[gi, j], back[gi, j] = cand[i], i
+    # fewer groups can never help but handle k < max_groups
+    bounds = []
+    j = k
+    for gi in range(g, 0, -1):
+        i = back[gi, j]
+        bounds.append((i, j))
+        j = i
+    bounds.reverse()
+
+    order = np.argsort(-degrees, kind="stable")  # degree-desc entity ids
+    order = order[degrees[order] > 0]
+    out = []
+    # map distinct-degree ranges back to entity index ranges
+    ent_csum = 0
+    for i, j in bounds:
+        cnt = int(csum[j] - csum[i])
+        ids = order[ent_csum:ent_csum + cnt]
+        out.append((int(distinct[i]), ids))
+        ent_csum += cnt
+    return out
+
+
+def bucketed_ell_from_arrays(rows, cols, vals, n_rows: int, n_cols: int,
+                             max_groups: int = 8,
+                             dtype=jnp.float32) -> BucketedEllFeatures:
+    """Build the degree-bucketed dual-ELL layout from COO triplets."""
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    vals = np.asarray(vals)
+    if n_cols > np.iinfo(np.int32).max or n_rows > np.iinfo(np.int32).max:
+        raise ValueError("bucketed ELL uses int32 ids; shard the problem "
+                         "into column blocks past 2^31")
+
+    def pack(major, minor, nmaj):
+        """ELL-pack along `major`, grouped by degree. Returns
+        (vals_list, idx_list, inv)."""
+        deg = np.bincount(major, minlength=nmaj)
+        order = np.lexsort((minor, major))
+        starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
+        groups = _degree_groups(deg, max_groups)
+        vlist, ilist = [], []
+        inv = np.full(nmaj, -1, np.int64)
+        offset = 0
+        for width, ids in groups:
+            pos = starts[ids][:, None] + np.arange(width)[None, :]
+            mask = np.arange(width)[None, :] < deg[ids][:, None]
+            sl = order[np.minimum(pos, len(order) - 1)]
+            nv = np.where(mask, vals[sl], 0).astype(vals.dtype)
+            ni = np.where(mask, minor[sl], 0).astype(np.int32)
+            vlist.append(jnp.asarray(nv, dtype))
+            ilist.append(jnp.asarray(ni))
+            inv[ids] = offset + np.arange(len(ids))
+            offset += len(ids)
+        inv[inv < 0] = offset  # degree-0 entities -> trailing zero slot
+        return tuple(vlist), tuple(ilist), jnp.asarray(inv.astype(np.int32))
+
+    rv, rc, rinv = pack(rows, cols, n_rows)
+    cv, cr, cinv = pack(cols, rows, n_cols)
+    return BucketedEllFeatures(
+        row_vals=rv, row_cols=rc, row_inv=rinv,
+        col_vals=cv, col_rows=cr, col_inv=cinv,
+        n_rows=int(n_rows), n_features=int(n_cols))
+
+
+def bucketed_ell_from_scipy(mat, max_groups: int = 8,
+                            dtype=jnp.float32) -> BucketedEllFeatures:
+    coo = mat.tocoo()
+    return bucketed_ell_from_arrays(coo.row, coo.col, coo.data,
+                                    coo.shape[0], coo.shape[1],
+                                    max_groups=max_groups, dtype=dtype)
+
+
 FeatureMatrix = Union[DenseFeatures, CSRFeatures, BlockedCSRFeatures,
-                      BlockedEllFeatures, KroneckerFeatures]
+                      BlockedEllFeatures, BucketedEllFeatures,
+                      KroneckerFeatures]
 
 
 def csr_from_scipy(mat, n_features: int | None = None, pad_to: int | None = None,
@@ -527,9 +724,11 @@ def features_to_device(mat, dtype=jnp.float32,
     density. The single chooser shared by the GLM and GAME ingest paths.
 
     For LARGE sparse problems (nnz beyond a few million) on TPU, build
-    ``blocked_ell_from_scipy`` explicitly instead: CSR's transpose product
-    is scatter-bound (~120M updates/s measured), while dual-ELL is
-    gather-only at ~2x the memory — see docs/SCALE.md."""
+    ``bucketed_ell_from_scipy`` explicitly instead: CSR's transpose
+    product is scatter-bound, while degree-bucketed dual-ELL is
+    gather-only with near-nnz slot counts at ~2x the memory — see
+    docs/SCALE.md. Use ``blocked_ell_from_scipy`` for the mesh-sharded
+    (column-blocked) variant."""
     import scipy.sparse as sp
 
     if sp.issparse(mat):
