@@ -41,7 +41,7 @@ from photon_ml_tpu.optimization.convergence import (
 from photon_ml_tpu.optimization.lbfgs import (
     _LBFGSHistory,
     _empty_history,
-    two_loop_direction,
+    compact_direction,
     update_history,
 )
 
@@ -99,7 +99,7 @@ def _minimize_lbfgs_glm_impl(
         return st.reason == int(ConvergenceReason.NOT_CONVERGED)
 
     def body(st: _State):
-        direction = two_loop_direction(st.g, st.hist)
+        direction = compact_direction(st.g, st.hist)
         dg = jnp.vdot(direction, st.g)
         use_sd = dg >= 0
         direction = jnp.where(use_sd, -st.g, direction)

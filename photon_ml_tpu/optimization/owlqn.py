@@ -33,7 +33,7 @@ from photon_ml_tpu.optimization.lbfgs import (
     _LBFGSHistory,
     _empty_history,
     backtracking_line_search,
-    two_loop_direction,
+    compact_direction,
     update_history,
 )
 
@@ -109,7 +109,7 @@ def _minimize_owlqn_impl(
         return st.reason == int(ConvergenceReason.NOT_CONVERGED)
 
     def body(st: _State):
-        direction = two_loop_direction(st.pg, st.hist)
+        direction = compact_direction(st.pg, st.hist)
         # Sign projection: keep only components that agree with -pg.
         direction = jnp.where(direction * st.pg < 0, direction, 0.0)
         degenerate = jnp.vdot(direction, st.pg) >= 0

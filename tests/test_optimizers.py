@@ -226,3 +226,29 @@ def test_already_optimal_start():
                                  ConvergenceReason.FUNCTION_VALUES_CONVERGED)
     assert int(res.iterations) <= 1
     np.testing.assert_allclose(np.asarray(res.x), CENTER, atol=1e-12)
+
+
+def test_compact_direction_matches_two_loop(rng):
+    """The Byrd-Nocedal compact representation is algebraically identical
+    to the two-loop recursion — check on random histories: empty, partial
+    (leading zero slots), and full, with curvature-positive pairs."""
+    from photon_ml_tpu.optimization.lbfgs import (
+        _empty_history,
+        compact_direction,
+        two_loop_direction,
+        update_history,
+    )
+
+    d, m = 17, 6
+    for n_pairs in (0, 1, 3, 6, 9):
+        hist = _empty_history(d, m, jnp.float64)
+        for _ in range(n_pairs):
+            s = jnp.asarray(rng.normal(0, 1, d))
+            y = s * rng.uniform(0.5, 2.0) + 0.1 * jnp.asarray(
+                rng.normal(0, 1, d))  # keep s.y > 0
+            hist = update_history(hist, s, y)
+        g = jnp.asarray(rng.normal(0, 1, d))
+        np.testing.assert_allclose(
+            np.asarray(compact_direction(g, hist)),
+            np.asarray(two_loop_direction(g, hist)),
+            rtol=1e-9, atol=1e-11)
