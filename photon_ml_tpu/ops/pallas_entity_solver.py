@@ -1,0 +1,365 @@
+"""Pallas TPU kernel: the whole per-entity GLM L-BFGS solve fused into one
+kernel, entities vectorized along lanes.
+
+The random-effect coordinate solves thousands of tiny independent GLMs
+(reference: one Breeze L-BFGS per entity inside a shuffled executor task,
+ml/algorithm/RandomEffectCoordinate.scala:104-113). The jnp path runs them
+as ONE vmapped masked `lax.while_loop` — correct and portable, but every
+XLA op in the loop body is a separate HBM-roundtrip launch: ~50 tiny ops
+per L-BFGS iteration, each streaming [E, d]-shaped intermediates to HBM
+and back. At bucket sizes the solve is pure launch/bandwidth overhead
+(measured: the 100k-entity sweep spent ~185 ms on ~0.1 ms of FLOPs).
+
+This kernel runs the ENTIRE solve — margins, batched-Armijo line search,
+two-loop direction, cautious history updates, convergence bookkeeping —
+for 128 entities per grid step, with all state resident in VMEM/registers.
+The only HBM traffic is one read of the entity block and one write of the
+results. Grid steps pipeline across entity tiles.
+
+Layout: entities along the 128-lane axis; every array the kernel touches
+is 2-D [sublanes, 128] (Mosaic's native vreg shape — 3-D contractions do
+not lower). Per grid step the kernel sees
+  x rows x_ref[i] [d, 128] (i < r), labels/offsets/weights [r, 128],
+  coef0 [d, 128]
+and carries state c/g [d, 128], z [r, 128], and the (s, y) history as m
+static pairs of [d, 128] arrays. Every reduction is over sublanes (r or
+d); nothing crosses lanes, so 128 solves proceed in lockstep with
+per-lane `done` masking — the same semantics as the vmapped host solver
+(identical convergence reasons and tolerances; all line-search candidates
+are priced as one [T, 128] block per row, and the accepted step is the
+FIRST Armijo-passing candidate, like optimization/glm_lbfgs.py's batched
+search with its tail folded in).
+
+Routing: algorithm/coordinates.py uses this kernel for random-effect
+bucket solves on TPU (unconstrained, L2-only, un-normalized — exactly the
+random-effect configuration); anything else falls back to the vmapped jnp
+path. Set PHOTON_ML_TPU_NO_PALLAS=1 to disable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optimization.convergence import (
+    ConvergenceReason,
+    OptimizerResult,
+)
+
+Array = jax.Array
+
+LANES = 128
+_CAUTIOUS_EPS = 1e-10
+
+
+class _KState(NamedTuple):
+    c: Array  # [d, L]
+    z: Array  # [r, L]
+    f: Array  # [1, L]
+    g: Array  # [d, L]
+    s_hist: Tuple[Array, ...]  # m x [d, L], oldest first
+    y_hist: Tuple[Array, ...]  # m x [d, L]
+    rho: Array  # [m, L]
+    count: Array  # [1, L] i32
+    it: Array  # [1, L] i32
+    reason: Array  # [1, L] i32
+    gnorm: Array  # [1, L]
+    k: Array  # scalar i32 loop counter
+
+
+def _rsum(a):
+    """Sublane reduction -> [1, L]."""
+    return jnp.sum(a, axis=0, keepdims=True)
+
+
+def _two_loop(g, s_hist, y_hist, rho, count):
+    """Two-loop recursion vectorized over lanes; reductions over sublanes.
+    Inside a fused kernel the 4m-deep chain is register work, so the
+    compact representation's op-count advantage (lbfgs.py) is moot and
+    the recursion's lower arithmetic count wins."""
+    m = len(s_hist)
+    q = g
+    alphas = []
+    for j in reversed(range(m)):
+        alpha = rho[j:j + 1] * _rsum(s_hist[j] * q)  # [1, L]
+        q = q - alpha * y_hist[j]
+        alphas.append(alpha)
+    alphas.reverse()
+
+    yy = _rsum(y_hist[-1] * y_hist[-1])
+    sy = _rsum(s_hist[-1] * y_hist[-1])
+    gamma = jnp.where(count > 0, sy / jnp.maximum(yy, _CAUTIOUS_EPS), 1.0)
+    rr = gamma * q
+    for j in range(m):
+        beta = rho[j:j + 1] * _rsum(y_hist[j] * rr)
+        rr = rr + (alphas[j] - beta) * s_hist[j]
+    return -rr
+
+
+
+def _sel(mask, a, b):
+    """where(mask, a, b) for a [1, L] bool mask against [k, L] data —
+    Mosaic cannot relayout a sublane-replicated select, so use the
+    arithmetic form (both branches are finite everywhere this is used)."""
+    if a.shape == mask.shape and a.dtype == jnp.int32:
+        return jnp.where(mask, a, b)
+    m = mask.astype(a.dtype)
+    return b + m * (a - b)
+
+
+def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
+                 m: int, c1: float, max_line_search: int):
+    not_conv = np.int32(int(ConvergenceReason.NOT_CONVERGED))
+    shrink = 0.5
+    n_trials = max_line_search + 1
+
+    def kernel(l2_ref, x_ref, y_ref, off_ref, w_ref, c0_ref,
+               out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
+               out_reason_ref):
+        yv = y_ref[:]  # [r, L]
+        off = off_ref[:]
+        w = w_ref[:]
+        l2 = l2_ref[0]
+        x_rows = [x_ref[i] for i in range(r)]  # each [d, L]
+
+        def margins(c):
+            return jnp.concatenate(
+                [_rsum(x_rows[i] * c) for i in range(r)], axis=0) + off
+
+        def value_from(z, csq):
+            return _rsum(w * loss.loss(z, yv)) + 0.5 * l2 * csq
+
+        def grad_from(c, z):
+            u = w * loss.d1(z, yv)  # [r, L]
+            g = l2 * c
+            for i in range(r):
+                g = g + x_rows[i] * u[i:i + 1]
+            return g
+
+        c0 = c0_ref[:]
+        z0 = margins(c0)
+        f0 = value_from(z0, _rsum(c0 * c0))
+        g0 = grad_from(c0, z0)
+        gnorm0 = jnp.sqrt(_rsum(g0 * g0))
+        f0_scale = jnp.maximum(jnp.abs(f0), 1e-30)
+
+        # History buffers are initialized as 0*data rather than zeros:
+        # a constant-zero carry gets a sublane-REPLICATED Mosaic layout,
+        # and the loop body's shift-update (non-replicated) then needs an
+        # invalid relayout of a non-singleton dimension.
+        state = _KState(
+            c=c0, z=z0, f=f0, g=g0,
+            s_hist=tuple(c0 * 0.0 for _ in range(m)),
+            y_hist=tuple(c0 * 0.0 for _ in range(m)),
+            rho=jnp.concatenate([f0 * 0.0 for _ in range(m)], axis=0),
+            count=jnp.zeros((1, c0.shape[1]), jnp.int32),
+            it=jnp.zeros((1, c0.shape[1]), jnp.int32),
+            reason=jnp.where(
+                gnorm0 <= 0.0, int(ConvergenceReason.GRADIENT_CONVERGED),
+                int(ConvergenceReason.NOT_CONVERGED)).astype(jnp.int32),
+            gnorm=gnorm0,
+            k=jnp.zeros((), jnp.int32),
+        )
+
+        def body(st: _KState) -> _KState:
+            active = st.reason == not_conv  # [1, L]
+            direction = _two_loop(st.g, st.s_hist, st.y_hist, st.rho,
+                                  st.count)
+            dg = _rsum(direction * st.g)
+            direction = _sel(dg >= 0, -st.g, direction)
+
+            zp = margins(direction) - off  # [r, L]
+            xx = _rsum(st.c * st.c)
+            xp = _rsum(st.c * direction)
+            pp = _rsum(direction * direction)
+            gp = _rsum(st.g * direction)
+
+            first = st.count == 0
+            init_step = jnp.where(first,
+                                  1.0 / jnp.maximum(jnp.sqrt(pp), 1.0), 1.0)
+
+            # All Armijo candidates priced as one [T, L] block, data term
+            # accumulated row by row; the accepted step is the FIRST
+            # passing candidate — identical to sequential backtracking.
+            ks = lax.broadcasted_iota(jnp.int32, (n_trials, 1), 0
+                                      ).astype(st.f.dtype)
+            ts = init_step * jnp.power(jnp.asarray(shrink, st.f.dtype), ks)
+            data_t = jnp.zeros_like(ts)  # [T, L] via broadcast below
+            for i in range(r):
+                z_ti = st.z[i:i + 1] + ts * zp[i:i + 1]  # [T, L]
+                data_t = data_t + w[i:i + 1] * loss.loss(z_ti, yv[i:i + 1])
+            csq_t = xx + 2.0 * ts * xp + ts * ts * pp
+            f_t = data_t + 0.5 * l2 * csq_t  # [T, L]
+            armijo = jnp.logical_and(f_t <= st.f + c1 * ts * gp,
+                                     jnp.isfinite(f_t))
+            ok = jnp.any(armijo, axis=0, keepdims=True)  # [1, L]
+            # First passing candidate per lane: candidates are strictly
+            # decreasing (ts[0] > ts[1] > ... > 0), so "first" = the MAX
+            # passing step — a plain reduction, no scan.
+            t_acc = jnp.max(jnp.where(armijo, ts, 0.0), axis=0,
+                            keepdims=True)
+            hit = jnp.logical_and(armijo, ts == t_acc)
+            f_new = jnp.sum(jnp.where(hit, f_t, 0.0), axis=0,
+                            keepdims=True)
+
+            c_new = st.c + t_acc * direction
+            z_new = st.z + t_acc * zp
+            g_new = grad_from(c_new, z_new)
+
+            s_vec = c_new - st.c
+            y_vec = g_new - st.g
+            sy = _rsum(s_vec * y_vec)
+            s_n = jnp.sqrt(_rsum(s_vec * s_vec))
+            y_n = jnp.sqrt(_rsum(y_vec * y_vec))
+            store = jnp.logical_and(ok, sy > _CAUTIOUS_EPS * s_n * y_n)
+            s_hist = tuple(
+                _sel(store, nxt, old) for nxt, old in
+                zip(st.s_hist[1:] + (s_vec,), st.s_hist))
+            y_hist = tuple(
+                _sel(store, nxt, old) for nxt, old in
+                zip(st.y_hist[1:] + (y_vec,), st.y_hist))
+            rho_shift = jnp.concatenate(
+                [st.rho[1:], jnp.where(sy != 0, 1.0 / sy, 0.0)], axis=0)
+            rho = _sel(store, rho_shift, st.rho)
+            count = jnp.where(store,
+                              jnp.minimum(st.count + 1, m), st.count)
+
+            it_new = st.it + 1
+            gnorm_new = jnp.sqrt(_rsum(g_new * g_new))
+            f_delta = jnp.abs(st.f - f_new)
+            reason = jnp.where(
+                ~ok, int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
+                jnp.where(
+                    gnorm_new <= tol * gnorm0,
+                    int(ConvergenceReason.GRADIENT_CONVERGED),
+                    jnp.where(
+                        f_delta <= tol * f0_scale,
+                        int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
+                        jnp.where(it_new >= max_iter,
+                                  int(ConvergenceReason.MAX_ITERATIONS),
+                                  not_conv)))).astype(jnp.int32)
+
+            # Failed line search must not move the iterate.
+            c_new = _sel(ok, c_new, st.c)
+            z_new = _sel(ok, z_new, st.z)
+            f_new = jnp.where(ok, f_new, st.f)
+            g_new = _sel(ok, g_new, st.g)
+            gnorm_new = jnp.where(ok, gnorm_new, st.gnorm)
+
+            # Frozen (converged) lanes keep their previous state.
+            msk = lambda a, b: (jnp.where(active, a, b)
+                                if a.shape == active.shape
+                                else _sel(active, a, b))
+            return _KState(
+                c=msk(c_new, st.c), z=msk(z_new, st.z),
+                f=msk(f_new, st.f), g=msk(g_new, st.g),
+                s_hist=tuple(msk(a, b)
+                             for a, b in zip(s_hist, st.s_hist)),
+                y_hist=tuple(msk(a, b)
+                             for a, b in zip(y_hist, st.y_hist)),
+                rho=msk(rho, st.rho),
+                count=msk(count, st.count),
+                it=msk(it_new, st.it),
+                reason=msk(reason, st.reason),
+                gnorm=msk(gnorm_new, st.gnorm),
+                k=st.k + 1)
+
+        def cond(st: _KState):
+            return jnp.logical_and(st.k < max_iter,
+                                   jnp.any(st.reason == not_conv))
+
+        final = lax.while_loop(cond, body, state)
+
+        out_c_ref[:] = final.c
+        out_f_ref[:] = final.f
+        out_gnorm_ref[:] = final.gnorm
+        out_it_ref[:] = final.it
+        out_reason_ref[:] = final.reason
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("loss", "max_iter", "tol", "m", "c1",
+                     "max_line_search", "interpret"))
+def pallas_entity_lbfgs(
+    loss: PointwiseLoss,
+    x: Array,  # [E, r, d]
+    labels: Array,  # [E, r]
+    offsets: Array,  # [E, r]
+    weights: Array,  # [E, r]
+    coef0: Array,  # [E, d]
+    l2_weight,
+    *,
+    max_iter: int = 100,
+    tol: float = 1e-7,
+    m: int = 10,
+    c1: float = 1e-4,
+    max_line_search: int = 30,
+    interpret: bool = False,
+) -> OptimizerResult:
+    """Batched per-entity unconstrained L2 GLM L-BFGS via the fused Pallas
+    kernel. Returns an OptimizerResult with [E]-leading leaves (value /
+    gradient-norm histories are not tracked on this path — None)."""
+    e, r, d = x.shape
+    dtype = x.dtype
+    ep = -(-e // LANES) * LANES
+    pad = ep - e
+
+    def to_lanes(a, trail):
+        a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+        return jnp.moveaxis(a, 0, -1).reshape(trail + (ep,))
+
+    x_l = to_lanes(x, (r, d))
+    y_l = to_lanes(labels.astype(dtype), (r,))
+    off_l = to_lanes(offsets.astype(dtype), (r,))
+    w_l = to_lanes(weights.astype(dtype), (r,))  # pad weights are 0
+    c0_l = to_lanes(coef0.astype(dtype), (d,))
+
+    kernel = _make_kernel(loss, r=r, max_iter=max_iter, tol=tol, m=m,
+                          c1=c1, max_line_search=max_line_search)
+    grid = (ep // LANES,)
+
+    def bspec(*trail):
+        return pl.BlockSpec(trail + (LANES,),
+                            lambda i: (0,) * len(trail) + (i,),
+                            memory_space=pltpu.VMEM)
+
+    out_shapes = (
+        jax.ShapeDtypeStruct((d, ep), dtype),   # coef
+        jax.ShapeDtypeStruct((1, ep), dtype),   # value
+        jax.ShapeDtypeStruct((1, ep), dtype),   # grad norm
+        jax.ShapeDtypeStruct((1, ep), jnp.int32),  # iterations
+        jax.ShapeDtypeStruct((1, ep), jnp.int32),  # reason
+    )
+    c_l, f_l, gn_l, it_l, reason_l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # l2 scalar
+            bspec(r, d), bspec(r), bspec(r), bspec(r), bspec(d),
+        ],
+        out_specs=(bspec(d), bspec(1), bspec(1), bspec(1), bspec(1)),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(jnp.asarray(l2_weight, dtype).reshape(1), x_l, y_l, off_l, w_l, c0_l)
+
+    return OptimizerResult(
+        x=jnp.moveaxis(c_l, -1, 0)[:e],
+        value=f_l[0, :e],
+        grad_norm=gn_l[0, :e],
+        iterations=it_l[0, :e],
+        reason=reason_l[0, :e],
+        value_history=None,
+        grad_norm_history=None,
+        coef_history=None,
+    )

@@ -118,10 +118,13 @@ def test_heart_logistic_quality():
     ref = so.minimize(nll, np.zeros(dense.shape[1]), method="L-BFGS-B",
                       options={"maxiter": 500, "ftol": 1e-14})
     assert float(result.value) <= ref.fun * (1 + 1e-5)
-    # atol reflects the Armijo-backtracking solver's stall floor on this
-    # problem (both the two-loop and compact-representation directions end
-    # with |Δf| below 1e-12·f0 while coefficients still wander ~3e-4 around
-    # the optimum; objective values agree with scipy to 8 digits above).
+    # Principled coefficient tolerance from strong convexity: the L2 term
+    # 5·wᵀw makes the objective 10-strongly-convex, so the value bound
+    # just asserted (f − f* ≤ 1e-5·f* ≈ 9.4e-4) implies
+    # ‖w − w*‖ ≤ sqrt(2·9.4e-4/10) ≈ 1.4e-2. The Armijo-backtracking
+    # solver stalls ~3e-4 from the optimum on this problem (measured for
+    # BOTH the two-loop and compact-representation directions); atol=1e-3
+    # sits between the observed stall and the provable bound.
     np.testing.assert_allclose(coef, ref.x, rtol=1e-3, atol=1e-3)
 
     auc_train = area_under_roc_curve(mat @ coef, y)
