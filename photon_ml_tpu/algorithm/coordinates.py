@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.data.random_effect import EntityBlock, RandomEffectDataset
@@ -436,7 +437,8 @@ class FactoredRandomEffectCoordinate(Coordinate):
         for _ in range(self.mf_config.max_iterations):
             gammas = [
                 _solve_factored_block(
-                    self._objective, self.config, block, B, extra, g0, d).x
+                    self._objective, self.config, block, B, extra, g0, d,
+                    sharded=self.mesh is not None).x
                 for block, extra, g0 in zip(blocks, residuals, gammas)]
             batch = GLMBatch(
                 KroneckerFeatures(x_flat, _flatten_gammas(blocks, gammas)),
@@ -461,16 +463,25 @@ class FactoredRandomEffectCoordinate(Coordinate):
         return out
 
 
-@functools.partial(jax.jit, static_argnames=("objective", "config", "d"))
+@functools.partial(
+    jax.jit, static_argnames=("objective", "config", "d", "sharded"))
 def _solve_factored_block(
     objective: GLMObjective, config: GLMOptimizationConfiguration,
     block: EntityBlock, B, extra_offsets, gamma0, d: int,
+    sharded: bool = False,
 ):
     """Per-entity latent solves against the current B: one projection einsum
-    for the whole bucket, then the vmapped masked solve."""
+    for the whole bucket, then the batched solve (fused Pallas kernel on
+    TPU — the latent bucket has the same shape contract as the
+    random-effect one, see _solve_block)."""
     lat = jnp.einsum("end,kd->enk", block.x[..., :d], B)
     offsets = block.offsets if extra_offsets is None else \
         block.offsets + extra_offsets.astype(block.offsets.dtype)
+
+    if _use_pallas_entity_solver(objective, config, lat, sharded):
+        return _dispatch_pallas_solver(objective, config, lat,
+                                       block.labels, offsets,
+                                       block.weights, gamma0)
 
     def fit_one(g0, x_lat, y, off, w):
         from photon_ml_tpu.ops.features import DenseFeatures
@@ -529,7 +540,31 @@ def _gather_residual(residual_scores: Optional[Array],
     return ext[block.row_ids]
 
 
-def _use_pallas_entity_solver(objective, config, block,
+def _dispatch_pallas_solver(objective, config, x, labels, offsets,
+                            weights, coef0):
+    """Shared kernel dispatch for the random-effect and factored-latent
+    bucket solves — one place owns the l2 derivation and the kernel call
+    so the two paths cannot diverge."""
+    from photon_ml_tpu.ops.pallas_entity_solver import pallas_entity_lbfgs
+
+    rc = config.regularization_context
+    l2 = rc.l2_weight(config.regularization_weight) if rc else 0.0
+    return pallas_entity_lbfgs(
+        objective.loss, x, labels, offsets, weights, coef0, l2,
+        max_iter=config.max_iterations, tol=config.tolerance,
+        interpret=_pallas_interpret())
+
+
+def _pallas_interpret() -> bool:
+    """PHOTON_ML_TPU_PALLAS_INTERPRET=1 forces the Pallas entity solver
+    (interpreter mode) on any backend — an end-to-end drive of the kernel
+    code path without TPU hardware. Trace-time, like NO_PALLAS."""
+    import os
+
+    return os.environ.get("PHOTON_ML_TPU_PALLAS_INTERPRET") == "1"
+
+
+def _use_pallas_entity_solver(objective, config, x,
                               sharded: bool) -> bool:
     """The fused Pallas kernel covers exactly the random-effect solve
     configuration: TPU backend, unconstrained L-BFGS, L2-only,
@@ -538,7 +573,7 @@ def _use_pallas_entity_solver(objective, config, block,
 
     ``sharded`` must be decided by the caller at the Python level (the
     coordinate knows whether a mesh shards its blocks) — inside a trace
-    ``block.x`` is a tracer and carries no sharding. All checks here use
+    ``x`` is a tracer and carries no sharding. All checks here use
     only static information (config, shapes, backend), so the decision
     is stable for a given jit cache entry. PHOTON_ML_TPU_NO_PALLAS=1
     disables the kernel; the flag is read when a solve first TRACES, so
@@ -550,7 +585,8 @@ def _use_pallas_entity_solver(objective, config, block,
 
     if sharded or os.environ.get("PHOTON_ML_TPU_NO_PALLAS") == "1":
         return False
-    if jax.default_backend() != "tpu":
+    if (jax.default_backend() != "tpu"
+            and not _pallas_interpret()):  # interpret: kernel on any backend
         return False
     if config.optimizer_type != OptimizerType.LBFGS:
         return False
@@ -563,8 +599,8 @@ def _use_pallas_entity_solver(objective, config, block,
     # buffers + c/g/direction, the [T, 128] line-search block, and the
     # double-buffered input pipeline. Stay well under the ~16 MB/core
     # budget; oversize buckets keep the vmapped path.
-    e, r, d = block.x.shape
-    itemsize = np.dtype(block.x.dtype).itemsize
+    e, r, d = x.shape
+    itemsize = np.dtype(x.dtype).itemsize
     vmem = (2 * r * d + 2 * 10 * d + 8 * d + 8 * r + 64) * 128 * itemsize
     return vmem < 10 * 2**20
 
@@ -591,17 +627,10 @@ def _solve_block(
     if extra is not None:
         offsets = offsets + extra.astype(offsets.dtype)
 
-    if _use_pallas_entity_solver(objective, config, block, sharded):
-        from photon_ml_tpu.ops.pallas_entity_solver import (
-            pallas_entity_lbfgs,
-        )
-
-        rc = config.regularization_context
-        l2 = rc.l2_weight(config.regularization_weight) if rc else 0.0
-        return pallas_entity_lbfgs(
-            objective.loss, block.x, block.labels, offsets, block.weights,
-            coefs0, l2, max_iter=config.max_iterations,
-            tol=config.tolerance)
+    if _use_pallas_entity_solver(objective, config, block.x, sharded):
+        return _dispatch_pallas_solver(objective, config, block.x,
+                                       block.labels, offsets,
+                                       block.weights, coefs0)
 
     def fit_one(coef0, x, y, off, w):
         from photon_ml_tpu.ops.features import DenseFeatures

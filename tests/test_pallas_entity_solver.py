@@ -93,3 +93,44 @@ def test_pallas_solver_zero_weight_entities(rng):
         max_iter=20, tol=1e-7, interpret=True)
     assert int(res.iterations[2]) == 0
     np.testing.assert_array_equal(np.asarray(res.x[2]), 0.0)
+
+
+def test_solve_block_routes_through_kernel(monkeypatch, rng):
+    """PHOTON_ML_TPU_PALLAS_INTERPRET=1 routes _solve_block through the
+    fused kernel on any backend (interpreter mode) — the end-to-end drive
+    of the routing layer without TPU hardware. The kernel path is
+    distinguishable by its untracked histories (value_history is None)."""
+    from photon_ml_tpu.algorithm.coordinates import _solve_block
+    from photon_ml_tpu.data.random_effect import EntityBlock
+    from photon_ml_tpu.ops.glm_objective import GLMObjective as Obj
+
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d = 23, 5, 4
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    block = EntityBlock(
+        x=jnp.asarray(x), labels=jnp.asarray(y), offsets=jnp.asarray(off),
+        weights=jnp.asarray(w),
+        row_ids=np.zeros((e, r), np.int32),
+        feat_idx=np.broadcast_to(np.arange(d, dtype=np.int32), (e, d)))
+    obj = Obj(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    c0 = jnp.zeros((e, d), dtype)
+
+    def cfg(tol):
+        # distinct tolerances force distinct jit cache entries — the
+        # routing env vars are read at trace time
+        return GLMOptimizationConfiguration(
+            max_iterations=25, tolerance=tol, regularization_weight=0.4,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2))
+
+    monkeypatch.setenv("PHOTON_ML_TPU_PALLAS_INTERPRET", "1")
+    res_k = _solve_block(obj, cfg(1e-7), block, None, c0)
+    assert res_k.value_history is None  # kernel path ran
+    monkeypatch.delenv("PHOTON_ML_TPU_PALLAS_INTERPRET")
+    res_v = _solve_block(obj, cfg(1.001e-7), block, None, c0)
+    assert res_v.value_history is not None  # vmapped path ran
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-6, f32_floor=1e-4))
+    np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
+                               atol=gold(1e-5, f32_floor=5e-3))
