@@ -31,7 +31,16 @@ Array = jax.Array
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class DenseFeatures:
-    """Dense feature matrix x: [n_rows, n_features]."""
+    """Dense feature matrix x: [n_rows, n_features].
+
+    ``x`` may be stored in bfloat16 (``DenseFeatures.bf16(...)`` or
+    ``features_to_device(..., storage_dtype=jnp.bfloat16)``): products
+    then read HALF the HBM bytes — the fixed-effect iteration is
+    bandwidth-bound, so this is ~2x on the dominant term — while every
+    contraction accumulates in the coefficient dtype via
+    ``preferred_element_type`` (the MXU natively takes bf16 inputs with
+    f32 accumulation; see docs/F32_PARITY.md for the loss-parity
+    validation recipe)."""
 
     x: Array
 
@@ -43,21 +52,36 @@ class DenseFeatures:
     def num_features(self) -> int:
         return self.x.shape[-1]
 
+    @classmethod
+    def bf16(cls, x) -> "DenseFeatures":
+        return cls(jnp.asarray(x, jnp.bfloat16))
+
+    def _acc(self, v: Array):
+        # Accumulate in the solver dtype, never in the storage dtype.
+        return jnp.promote_types(v.dtype, jnp.float32)
+
     def matvec(self, v: Array) -> Array:
         """x @ v -> [n_rows]. v may have a leading batch dim under vmap."""
-        return self.x @ v
+        return jnp.matmul(self.x, v, preferred_element_type=self._acc(v))
 
     def rmatvec(self, u: Array) -> Array:
         """x.T @ u -> [n_features]."""
-        return u @ self.x
+        return jnp.matmul(u, self.x, preferred_element_type=self._acc(u))
 
     def row_sq_matvec(self, v: Array) -> Array:
-        """(x*x) @ v — used for Hessian-diagonal aggregation."""
-        return (self.x * self.x) @ v
+        """(x*x) @ v — used for Hessian-diagonal aggregation. The square
+        is formed in the accumulation dtype (an elementwise convert XLA
+        fuses into the matmul's operand read — traffic stays at storage
+        width)."""
+        acc = self._acc(v)
+        xsq = self.x.astype(acc) * self.x.astype(acc)
+        return jnp.matmul(xsq, v, preferred_element_type=acc)
 
     def sq_rmatvec(self, u: Array) -> Array:
         """(x*x).T @ u -> [n_features] — per-feature weighted square sums."""
-        return u @ (self.x * self.x)
+        acc = self._acc(u)
+        xsq = self.x.astype(acc) * self.x.astype(acc)
+        return jnp.matmul(u, xsq, preferred_element_type=acc)
 
     def tree_flatten(self):
         return (self.x,), None
@@ -718,10 +742,15 @@ DENSE_DENSITY_THRESHOLD = 0.2
 
 
 def features_to_device(mat, dtype=jnp.float32,
-                       dense_threshold: float = DENSE_DENSITY_THRESHOLD
-                       ) -> FeatureMatrix:
+                       dense_threshold: float = DENSE_DENSITY_THRESHOLD,
+                       storage_dtype=None) -> FeatureMatrix:
     """Host feature matrix -> device layout, choosing dense vs CSR by
     density. The single chooser shared by the GLM and GAME ingest paths.
+
+    ``storage_dtype=jnp.bfloat16`` stores DENSE features at half width
+    (products accumulate in the solver dtype; ~2x on the
+    bandwidth-bound fixed-effect iteration — see DenseFeatures). Sparse
+    layouts ignore it (their cost is lookup-count-, not byte-, bound).
 
     For LARGE sparse problems (nnz beyond a few million) on TPU, build
     ``bucketed_ell_from_scipy`` explicitly instead: CSR's transpose
@@ -731,9 +760,10 @@ def features_to_device(mat, dtype=jnp.float32,
     (column-blocked) variant."""
     import scipy.sparse as sp
 
+    dense_dt = storage_dtype if storage_dtype is not None else dtype
     if sp.issparse(mat):
         density = mat.nnz / max(1, mat.shape[0] * mat.shape[1])
         if density >= dense_threshold:
-            return DenseFeatures(jnp.asarray(mat.toarray(), dtype))
+            return DenseFeatures(jnp.asarray(mat.toarray(), dense_dt))
         return csr_from_scipy(mat, dtype=dtype)
-    return DenseFeatures(jnp.asarray(np.asarray(mat), dtype))
+    return DenseFeatures(jnp.asarray(np.asarray(mat), dense_dt))

@@ -186,29 +186,55 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
             init_step = jnp.where(first,
                                   1.0 / jnp.maximum(jnp.sqrt(pp), 1.0), 1.0)
 
-            # All Armijo candidates priced as one [T, L] block, data term
+            # Armijo candidates priced as [T, L] blocks, data term
             # accumulated row by row; the accepted step is the FIRST
             # passing candidate — identical to sequential backtracking.
-            ks = lax.broadcasted_iota(jnp.int32, (n_trials, 1), 0
-                                      ).astype(st.f.dtype)
-            ts = init_step * jnp.power(jnp.asarray(shrink, st.f.dtype), ks)
-            data_t = jnp.zeros_like(ts)  # [T, L] via broadcast below
-            for i in range(r):
-                z_ti = st.z[i:i + 1] + ts * zp[i:i + 1]  # [T, L]
-                data_t = data_t + w[i:i + 1] * loss.loss(z_ti, yv[i:i + 1])
-            csq_t = xx + 2.0 * ts * xp + ts * ts * pp
-            f_t = data_t + 0.5 * l2 * csq_t  # [T, L]
-            armijo = jnp.logical_and(f_t <= st.f + c1 * ts * gp,
-                                     jnp.isfinite(f_t))
-            ok = jnp.any(armijo, axis=0, keepdims=True)  # [1, L]
-            # First passing candidate per lane: candidates are strictly
-            # decreasing (ts[0] > ts[1] > ... > 0), so "first" = the MAX
-            # passing step — a plain reduction, no scan.
-            t_acc = jnp.max(jnp.where(armijo, ts, 0.0), axis=0,
-                            keepdims=True)
-            hit = jnp.logical_and(armijo, ts == t_acc)
-            f_new = jnp.sum(jnp.where(hit, f_t, 0.0), axis=0,
-                            keepdims=True)
+            # TIERED: almost every iteration accepts within the first 8
+            # halvings, so the [T1, L] block is computed always and the
+            # [T-T1, L] tail only when some active lane failed all of
+            # tier 1 (lax.cond — the tail's r-row sweep is the single
+            # most expensive block in the kernel).
+            def price(ts):
+                data_t = jnp.zeros_like(ts)
+                for i in range(r):
+                    z_ti = st.z[i:i + 1] + ts * zp[i:i + 1]  # [T, L]
+                    data_t = data_t + w[i:i + 1] * loss.loss(
+                        z_ti, yv[i:i + 1])
+                csq_t = xx + 2.0 * ts * xp + ts * ts * pp
+                f_t = data_t + 0.5 * l2 * csq_t
+                armijo = jnp.logical_and(f_t <= st.f + c1 * ts * gp,
+                                         jnp.isfinite(f_t))
+                # First passing candidate per lane: candidates strictly
+                # decrease (ts[0] > ts[1] > ... > 0), so "first" = the
+                # MAX passing step — a plain reduction, no scan.
+                t_acc = jnp.max(jnp.where(armijo, ts, 0.0), axis=0,
+                                keepdims=True)
+                hit = jnp.logical_and(armijo, ts == t_acc)
+                f_acc = jnp.sum(jnp.where(hit, f_t, 0.0), axis=0,
+                                keepdims=True)
+                return jnp.any(armijo, axis=0, keepdims=True), t_acc, f_acc
+
+            t1 = min(n_trials, 8)
+            shr = jnp.asarray(shrink, st.f.dtype)
+
+            def steps(lo, hi):
+                ks = lax.broadcasted_iota(jnp.int32, (hi - lo, 1), 0
+                                          ).astype(st.f.dtype)
+                return init_step * jnp.power(shr, ks + float(lo))
+
+            ok, t_acc, f_new = price(steps(0, t1))
+            if n_trials > t1:
+                need_tail = jnp.any(jnp.logical_and(active, ~ok))
+
+                def with_tail(_):
+                    ok2, t2, f2 = price(steps(t1, n_trials))
+                    return (jnp.logical_or(ok, ok2),
+                            jnp.where(ok, t_acc, t2),
+                            jnp.where(ok, f_new, f2))
+
+                ok, t_acc, f_new = lax.cond(
+                    need_tail, with_tail,
+                    lambda _: (ok, t_acc, f_new), None)
 
             c_new = st.c + t_acc * direction
             z_new = st.z + t_acc * zp

@@ -127,8 +127,86 @@ def test_solve_block_routes_through_kernel(monkeypatch, rng):
     res_k = _solve_block(obj, cfg(1e-7), block, None, c0)
     assert res_k.value_history is None  # kernel path ran
     monkeypatch.delenv("PHOTON_ML_TPU_PALLAS_INTERPRET")
+    monkeypatch.setenv("PHOTON_ML_TPU_NO_PALLAS", "1")  # backend-independent
     res_v = _solve_block(obj, cfg(1.001e-7), block, None, c0)
     assert res_v.value_history is not None  # vmapped path ran
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-6, f32_floor=1e-4))
+    np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
+                               atol=gold(1e-5, f32_floor=5e-3))
+
+
+def test_pallas_solver_deep_backtracking_tail(rng):
+    """Force the tiered line search past tier 1 (8 candidates): Poisson
+    with large-scale features makes early trial margins overflow exp, so
+    the first finite+Armijo step sits deep in the backtracking schedule.
+    The kernel must agree with the vmapped solver (same candidate set)."""
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d = 9, 8, 3
+    x = (rng.normal(0, 1, (e, r, d)) * 30.0).astype(dtype)
+    y = rng.poisson(3.0, (e, r)).astype(dtype)
+    off = np.zeros((e, r), dtype)
+    w = np.ones((e, r), dtype)
+    loss = loss_for_task(TaskType.POISSON_REGRESSION)
+    obj = GLMObjective(loss)
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=30, tolerance=1e-8, regularization_weight=0.1,
+        regularization_context=RegularizationContext(RegularizationType.L2))
+
+    res_k = pallas_entity_lbfgs(
+        loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
+        jnp.asarray(w), jnp.zeros((e, d), dtype), 0.1,
+        max_iter=30, tol=1e-8, interpret=True)
+
+    def fit_one(c0, xe, ye, oe, we):
+        return solve_glm(obj, GLMBatch(DenseFeatures(xe), ye, oe, we),
+                         cfg, c0)
+
+    res_v = jax.vmap(fit_one)(jnp.zeros((e, d), dtype), jnp.asarray(x),
+                              jnp.asarray(y), jnp.asarray(off),
+                              jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-7, f32_floor=2e-4))
+    np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
+                               atol=gold(1e-4, f32_floor=1e-2))
+
+
+def test_factored_latent_solve_routes_through_kernel(monkeypatch, rng):
+    """The factored coordinate's latent (gamma) bucket solve routes
+    through the kernel too — drive _solve_factored_block both ways and
+    check solution parity (the projection einsum feeds the kernel a
+    [E, r, k] latent design)."""
+    from photon_ml_tpu.algorithm.coordinates import _solve_factored_block
+    from photon_ml_tpu.data.random_effect import EntityBlock
+    from photon_ml_tpu.ops.glm_objective import GLMObjective as Obj
+
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d, k = 17, 6, 5, 2
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    block = EntityBlock(
+        x=jnp.asarray(x), labels=jnp.asarray(y), offsets=jnp.asarray(off),
+        weights=jnp.asarray(w),
+        row_ids=np.zeros((e, r), np.int32),
+        feat_idx=np.broadcast_to(np.arange(d, dtype=np.int32), (e, d)))
+    B = jnp.asarray(rng.normal(0, 0.5, (k, d)).astype(dtype))
+    g0 = jnp.zeros((e, k), dtype)
+    obj = Obj(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+
+    def cfg(tol):
+        return GLMOptimizationConfiguration(
+            max_iterations=20, tolerance=tol, regularization_weight=0.3,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2))
+
+    monkeypatch.setenv("PHOTON_ML_TPU_PALLAS_INTERPRET", "1")
+    res_k = _solve_factored_block(obj, cfg(1e-7), block, B, None, g0, d)
+    assert res_k.value_history is None  # kernel path ran
+    monkeypatch.delenv("PHOTON_ML_TPU_PALLAS_INTERPRET")
+    monkeypatch.setenv("PHOTON_ML_TPU_NO_PALLAS", "1")
+    res_v = _solve_factored_block(obj, cfg(1.001e-7), block, B, None, g0, d)
+    assert res_v.value_history is not None
     np.testing.assert_allclose(np.asarray(res_k.value),
                                np.asarray(res_v.value),
                                rtol=gold(1e-6, f32_floor=1e-4))
