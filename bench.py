@@ -435,6 +435,37 @@ def main():
         print(json.dumps({"cpu_seconds_per_iter": per_iter}))
         return
 
+    # The remote-TPU tunnel can wedge hard enough that BACKEND INIT hangs
+    # (observed: a stuck pool grant blocks jax.devices() indefinitely).
+    # Probe it in a killable subprocess first; if the chip is unreachable,
+    # fall back to measuring on CPU and say so in the JSON rather than
+    # hanging the driver and recording nothing.
+    tpu_ok = False
+    probe_note = None
+    cpu_intentional = os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+    if not cpu_intentional:
+        try:
+            subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert any(d.platform == 'tpu' "
+                 "for d in jax.devices()), 'no TPU device'"],
+                capture_output=True, text=True, timeout=180, check=True)
+            tpu_ok = True
+        except Exception as e:  # noqa: BLE001
+            detail = ""
+            stderr = getattr(e, "stderr", None)
+            if isinstance(stderr, bytes):  # TimeoutExpired keeps raw bytes
+                stderr = stderr.decode("utf-8", "replace")
+            if stderr:
+                detail = " | " + stderr.strip().splitlines()[-1][:200]
+            probe_note = (f"TPU backend unreachable ({type(e).__name__}"
+                          f"{detail}); measured on host CPU instead")
+            print(f"# {probe_note}", file=sys.stderr)
+    if not tpu_ok:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     def _round(v, nd):
         return None if v != v else round(v, nd)  # NaN -> null in JSON
 
@@ -448,20 +479,30 @@ def main():
             print(f"# bench extra failed: {e}", file=sys.stderr)
             return default
 
+    nanpair = (float("nan"), 0)
+    fallback = not tpu_ok and not cpu_intentional
+    if fallback:
+        # The extras take tens of minutes at 1-core-CPU speed — measure
+        # only the headline so the driver still records a data point.
+        # (An EXPLICIT JAX_PLATFORMS=cpu run still measures everything.)
+        def _try(fn, default):  # noqa: F811
+            print("# extra skipped (cpu fallback)", file=sys.stderr)
+            return default
+
     data = build_problem()
     per_iter, objective = run_cd(data, num_iterations=10)
     full_per_iter, _ = _try(
         lambda: run_cd(data, num_iterations=5, full_game=True),
         (float("nan"), None))
-    fe_ms, fe_iters = _try(fe_lbfgs_iter_ms, (float("nan"), 0))
+    fe_ms, fe_iters = _try(fe_lbfgs_iter_ms, nanpair)
     fe_bf16_ms, _ = _try(lambda: fe_lbfgs_iter_ms(bf16_storage=True),
-                         (float("nan"), 0))
-    tron_ms, tron_iters = _try(tron_iter_ms, (float("nan"), 0))
-    owl_ms, owl_iters = _try(owlqn_iter_ms, (float("nan"), 0))
+                         nanpair)
+    tron_ms, tron_iters = _try(tron_iter_ms, nanpair)
+    owl_ms, owl_iters = _try(owlqn_iter_ms, nanpair)
     stream = _try(stream_bandwidth_gbps, float("nan"))
     big_ms, big_mlps, big_shape = _try(
         scale_fe_sparse, (float("nan"), float("nan"), "failed"))
-    re_ms, re_entities = _try(scale_re_100k_entities, (float("nan"), 0))
+    re_ms, re_entities = _try(scale_re_100k_entities, nanpair)
 
     # Analytic traffic per fixed-effect L-BFGS iteration: the direction
     # matvec and the accepted-point rmatvec each read X once (n*d*4
@@ -473,6 +514,8 @@ def main():
 
     baseline_s = None
     try:
+        if not tpu_ok:
+            raise RuntimeError("cpu run — baseline would be self-vs-self")
         env = dict(os.environ, PHOTON_BENCH_CPU_BASELINE="1",
                    JAX_PLATFORMS="cpu")
         out = subprocess.run(
@@ -487,7 +530,9 @@ def main():
         "metric": "game_glmix_cd_iters_per_sec",
         "value": round(1.0 / per_iter, 4),
         "unit": ("iters/sec (200k rows; d=200 fixed + 5k users x 25 "
-                 "random-effect features)"),
+                 "random-effect features)"
+                 + (" [CPU FALLBACK]" if fallback else
+                    " [CPU]" if cpu_intentional else "")),
         "vs_baseline": (round(baseline_s / per_iter, 2)
                         if baseline_s else None),
         "extra": {
@@ -533,6 +578,7 @@ def main():
             },
             "vs_baseline_note": "same JAX code on 1 host CPU (no JVM/Spark "
                                 "available to measure the reference itself)",
+            "tpu_probe": probe_note,
         },
     }
     print(json.dumps(result))

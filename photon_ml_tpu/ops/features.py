@@ -670,9 +670,11 @@ def bucketed_ell_from_arrays(rows, cols, vals, n_rows: int, n_cols: int,
 
     def pack(major, minor, nmaj):
         """ELL-pack along `major`, grouped by degree. Returns
-        (vals_list, idx_list, inv)."""
+        (vals_list, idx_list, inv). Only GROUPING by major is needed
+        (slot order within an entity's run is irrelevant to the
+        fixed-width reduction), so a single-key stable sort suffices."""
         deg = np.bincount(major, minlength=nmaj)
-        order = np.lexsort((minor, major))
+        order = np.argsort(major, kind="stable")
         starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
         groups = _degree_groups(deg, max_groups)
         vlist, ilist = [], []
