@@ -212,3 +212,33 @@ def test_factored_latent_solve_routes_through_kernel(monkeypatch, rng):
                                rtol=gold(1e-6, f32_floor=1e-4))
     np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
                                atol=gold(1e-5, f32_floor=5e-3))
+
+
+@pytest.mark.parametrize("e,r,d", [(1, 1, 1), (1, 3, 2), (129, 2, 1),
+                                   (128, 4, 7), (40, 1, 5)])
+def test_pallas_solver_edge_shapes(rng, e, r, d):
+    """Degenerate shapes: single entity, single row, single feature, and
+    entity counts straddling the 128-lane boundary."""
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    obj = GLMObjective(loss)
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=15, tolerance=1e-7, regularization_weight=0.6,
+        regularization_context=RegularizationContext(RegularizationType.L2))
+    res_k = pallas_entity_lbfgs(
+        loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
+        jnp.asarray(w), jnp.zeros((e, d), dtype), 0.6,
+        max_iter=15, tol=1e-7, interpret=True)
+
+    def fit_one(c0, xe, ye, oe, we):
+        return solve_glm(obj, GLMBatch(DenseFeatures(xe), ye, oe, we),
+                         cfg, c0)
+
+    res_v = jax.vmap(fit_one)(jnp.zeros((e, d), dtype), jnp.asarray(x),
+                              jnp.asarray(y), jnp.asarray(off),
+                              jnp.asarray(w))
+    assert res_k.x.shape == (e, d)
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-7, f32_floor=1e-4))
