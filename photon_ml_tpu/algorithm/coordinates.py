@@ -543,16 +543,18 @@ def _gather_residual(residual_scores: Optional[Array],
 def _dispatch_pallas_solver(objective, config, x, labels, offsets,
                             weights, coef0):
     """Shared kernel dispatch for the random-effect and factored-latent
-    bucket solves — one place owns the l2 derivation and the kernel call
-    so the two paths cannot diverge."""
+    bucket solves — one place owns the l1/l2 derivation and the kernel
+    call so the two paths cannot diverge. l1 > 0 selects the kernel's
+    OWL-QN mode (matching solve_glm's routing to minimize_owlqn)."""
     from photon_ml_tpu.ops.pallas_entity_solver import pallas_entity_lbfgs
 
     rc = config.regularization_context
+    l1 = rc.l1_weight(config.regularization_weight) if rc else 0.0
     l2 = rc.l2_weight(config.regularization_weight) if rc else 0.0
     return pallas_entity_lbfgs(
-        objective.loss, x, labels, offsets, weights, coef0, l2,
+        objective.loss, x, labels, offsets, weights, coef0, l2, l1,
         max_iter=config.max_iterations, tol=config.tolerance,
-        interpret=_pallas_interpret())
+        owlqn=l1 > 0, interpret=_pallas_interpret())
 
 
 def _pallas_interpret() -> bool:
@@ -566,10 +568,11 @@ def _pallas_interpret() -> bool:
 
 def _use_pallas_entity_solver(objective, config, x,
                               sharded: bool) -> bool:
-    """The fused Pallas kernel covers exactly the random-effect solve
-    configuration: TPU backend, unconstrained L-BFGS, L2-only,
-    un-normalized, UNSHARDED dense blocks that fit the kernel's VMEM
-    working set. Everything else stays on the portable vmapped path.
+    """The fused Pallas kernel covers the random-effect solve
+    configurations: TPU backend, unconstrained L-BFGS (L2, or OWL-QN
+    when the config carries an L1/elastic-net weight), un-normalized,
+    UNSHARDED dense blocks that fit the kernel's VMEM working set.
+    Everything else stays on the portable vmapped path.
 
     ``sharded`` must be decided by the caller at the Python level (the
     coordinate knows whether a mesh shards its blocks) — inside a trace
@@ -589,9 +592,6 @@ def _use_pallas_entity_solver(objective, config, x,
             and not _pallas_interpret()):  # interpret: kernel on any backend
         return False
     if config.optimizer_type != OptimizerType.LBFGS:
-        return False
-    rc = config.regularization_context
-    if rc is not None and rc.l1_weight(config.regularization_weight) > 0:
         return False
     if objective.normalization is not None:
         return False
@@ -617,11 +617,12 @@ def _solve_block(
     both stable for a persistent coordinate. The residual gather (the
     reference's addScoresToOffsets join) fuses into the same dispatch.
 
-    On TPU the standard random-effect configuration routes to the fused
-    Pallas kernel (ops/pallas_entity_solver.py) — the whole per-entity
-    L-BFGS solve as one kernel, ~5x over the vmapped op-by-op path;
-    other configurations (TRON, OWL-QN, bounds, normalization, CPU) use
-    the portable vmapped solver."""
+    On TPU the standard random-effect configurations (L-BFGS/L2 and
+    OWL-QN elastic-net) route to the fused Pallas kernel
+    (ops/pallas_entity_solver.py) — the whole per-entity solve as one
+    kernel, ~5x over the vmapped op-by-op path; other configurations
+    (TRON, bounds, normalization, CPU) use the portable vmapped
+    solver."""
     offsets = block.offsets
     extra = _gather_residual(residual_scores, block)
     if extra is not None:

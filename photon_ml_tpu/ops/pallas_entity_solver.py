@@ -31,9 +31,10 @@ FIRST Armijo-passing candidate, like optimization/glm_lbfgs.py's batched
 search with its tail folded in).
 
 Routing: algorithm/coordinates.py uses this kernel for random-effect
-bucket solves on TPU (unconstrained, L2-only, un-normalized — exactly the
-random-effect configuration); anything else falls back to the vmapped jnp
-path. Set PHOTON_ML_TPU_NO_PALLAS=1 to disable.
+bucket solves on TPU — unconstrained L-BFGS with L2, or OWL-QN for
+L1/elastic-net (``owlqn=True``), un-normalized; TRON, bounds,
+normalization and mesh-sharded blocks fall back to the vmapped jnp path.
+Set PHOTON_ML_TPU_NO_PALLAS=1 to disable.
 """
 
 from __future__ import annotations
@@ -53,6 +54,7 @@ from photon_ml_tpu.optimization.convergence import (
     ConvergenceReason,
     OptimizerResult,
 )
+from photon_ml_tpu.optimization.owlqn import pseudo_gradient
 
 Array = jax.Array
 
@@ -116,18 +118,20 @@ def _sel(mask, a, b):
 
 
 def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
-                 m: int, c1: float, max_line_search: int):
+                 m: int, c1: float, max_line_search: int,
+                 owlqn: bool = False):
     not_conv = np.int32(int(ConvergenceReason.NOT_CONVERGED))
     shrink = 0.5
     n_trials = max_line_search + 1
 
-    def kernel(l2_ref, x_ref, y_ref, off_ref, w_ref, c0_ref,
+    def kernel(l2_ref, l1_ref, x_ref, y_ref, off_ref, w_ref, c0_ref,
                out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
                out_reason_ref):
         yv = y_ref[:]  # [r, L]
         off = off_ref[:]
         w = w_ref[:]
         l2 = l2_ref[0]
+        l1 = l1_ref[0]
         x_rows = [x_ref[i] for i in range(r)]  # each [d, L]
 
         def margins(c):
@@ -144,11 +148,20 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
                 g = g + x_rows[i] * u[i:i + 1]
             return g
 
+        def pseudo_grad(c, g):
+            # optimization/owlqn.py's pseudo_gradient is pure elementwise
+            # jnp — the single shared implementation works inside the
+            # kernel unchanged (l1 broadcasts from the SMEM scalar).
+            return pseudo_gradient(c, g, l1)
+
         c0 = c0_ref[:]
         z0 = margins(c0)
         f0 = value_from(z0, _rsum(c0 * c0))
+        if owlqn:
+            f0 = f0 + l1 * _rsum(jnp.abs(c0))
         g0 = grad_from(c0, z0)
-        gnorm0 = jnp.sqrt(_rsum(g0 * g0))
+        conv_g0 = pseudo_grad(c0, g0) if owlqn else g0
+        gnorm0 = jnp.sqrt(_rsum(conv_g0 * conv_g0))
         f0_scale = jnp.maximum(jnp.abs(f0), 1e-30)
 
         # History buffers are initialized as 0*data rather than zeros:
@@ -168,6 +181,126 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
             gnorm=gnorm0,
             k=jnp.zeros((), jnp.int32),
         )
+
+        def finish(st, active, ok, c_new, z_new, f_new, g_new,
+                   gnorm_new):
+            """Shared tail: cautious history update, convergence reasons,
+            failed-line-search and frozen-lane masking."""
+            s_vec = c_new - st.c
+            y_vec = g_new - st.g
+            sy = _rsum(s_vec * y_vec)
+            s_n = jnp.sqrt(_rsum(s_vec * s_vec))
+            y_n = jnp.sqrt(_rsum(y_vec * y_vec))
+            store = jnp.logical_and(ok, sy > _CAUTIOUS_EPS * s_n * y_n)
+            s_hist = tuple(
+                _sel(store, nxt, old) for nxt, old in
+                zip(st.s_hist[1:] + (s_vec,), st.s_hist))
+            y_hist = tuple(
+                _sel(store, nxt, old) for nxt, old in
+                zip(st.y_hist[1:] + (y_vec,), st.y_hist))
+            rho_shift = jnp.concatenate(
+                [st.rho[1:], jnp.where(sy != 0, 1.0 / sy, 0.0)], axis=0)
+            rho = _sel(store, rho_shift, st.rho)
+            count = jnp.where(store,
+                              jnp.minimum(st.count + 1, m), st.count)
+
+            it_new = st.it + 1
+            f_delta = jnp.abs(st.f - f_new)
+            reason = jnp.where(
+                ~ok, int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
+                jnp.where(
+                    gnorm_new <= tol * gnorm0,
+                    int(ConvergenceReason.GRADIENT_CONVERGED),
+                    jnp.where(
+                        f_delta <= tol * f0_scale,
+                        int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
+                        jnp.where(it_new >= max_iter,
+                                  int(ConvergenceReason.MAX_ITERATIONS),
+                                  not_conv)))).astype(jnp.int32)
+
+            # Failed line search must not move the iterate.
+            c_new = _sel(ok, c_new, st.c)
+            z_new = _sel(ok, z_new, st.z)
+            f_new = jnp.where(ok, f_new, st.f)
+            g_new = _sel(ok, g_new, st.g)
+            gnorm_new = jnp.where(ok, gnorm_new, st.gnorm)
+
+            # Frozen (converged) lanes keep their previous state.
+            msk = lambda a, b: (jnp.where(active, a, b)
+                                if a.shape == active.shape
+                                else _sel(active, a, b))
+            return _KState(
+                c=msk(c_new, st.c), z=msk(z_new, st.z),
+                f=msk(f_new, st.f), g=msk(g_new, st.g),
+                s_hist=tuple(msk(a, b)
+                             for a, b in zip(s_hist, st.s_hist)),
+                y_hist=tuple(msk(a, b)
+                             for a, b in zip(y_hist, st.y_hist)),
+                rho=msk(rho, st.rho),
+                count=msk(count, st.count),
+                it=msk(it_new, st.it),
+                reason=msk(reason, st.reason),
+                gnorm=msk(gnorm_new, st.gnorm),
+                k=st.k + 1)
+
+        def body_owlqn(st: _KState) -> _KState:
+            """OWL-QN iteration (optimization/owlqn.py semantics):
+            pseudo-gradient direction with sign projection, trials
+            projected onto the current orthant (margins are NOT affine in
+            the step, so every trial re-computes margins — still register
+            work), curvature pairs from the smooth gradient only."""
+            active = st.reason == not_conv
+            pg = pseudo_grad(st.c, st.g)
+            direction = _two_loop(pg, st.s_hist, st.y_hist, st.rho,
+                                  st.count)
+            direction = jnp.where(direction * pg < 0, direction, 0.0)
+            degenerate = _rsum(direction * pg) >= 0
+            direction = _sel(degenerate, -pg, direction)
+
+            orthant = jnp.where(st.c != 0, jnp.sign(st.c), jnp.sign(-pg))
+            first = st.count == 0
+            dnorm = jnp.sqrt(_rsum(direction * direction))
+            init_step = jnp.where(first,
+                                  1.0 / jnp.maximum(dnorm, 1.0), 1.0)
+
+            def trial(t):
+                x_t = st.c + t * direction
+                x_t = jnp.where(jnp.sign(x_t) == orthant, x_t, 0.0)
+                z_t = margins(x_t)
+                f_t = (value_from(z_t, _rsum(x_t * x_t))
+                       + l1 * _rsum(jnp.abs(x_t)))
+                armijo = jnp.logical_and(
+                    f_t <= st.f + c1 * _rsum(pg * (x_t - st.c)),
+                    jnp.isfinite(f_t))
+                return armijo, x_t, z_t, f_t
+
+            def sweep(k_lo, k_hi, carry):
+                found, x_acc, z_acc, f_acc = carry
+                for k in range(k_lo, k_hi):
+                    t = init_step * (shrink ** k)
+                    a, x_t, z_t, f_t = trial(t)
+                    take = jnp.logical_and(a, ~found)
+                    x_acc = _sel(take, x_t, x_acc)
+                    z_acc = _sel(take, z_t, z_acc)
+                    f_acc = jnp.where(take, f_t, f_acc)
+                    found = jnp.logical_or(found, a)
+                return found, x_acc, z_acc, f_acc
+
+            t1 = min(n_trials, 8)
+            carry = (jnp.zeros_like(active), st.c, st.z, st.f)
+            carry = sweep(0, t1, carry)
+            if n_trials > t1:
+                need_tail = jnp.any(jnp.logical_and(active, ~carry[0]))
+                carry = lax.cond(need_tail,
+                                 lambda c: sweep(t1, n_trials, c),
+                                 lambda c: c, carry)
+            ok, c_new, z_new, f_new = carry
+
+            g_new = grad_from(c_new, z_new)
+            pg_new = pseudo_grad(c_new, g_new)
+            gnorm_new = jnp.sqrt(_rsum(pg_new * pg_new))
+            return finish(st, active, ok, c_new, z_new, f_new, g_new,
+                          gnorm_new)
 
         def body(st: _KState) -> _KState:
             active = st.reason == not_conv  # [1, L]
@@ -239,70 +372,15 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
             c_new = st.c + t_acc * direction
             z_new = st.z + t_acc * zp
             g_new = grad_from(c_new, z_new)
-
-            s_vec = c_new - st.c
-            y_vec = g_new - st.g
-            sy = _rsum(s_vec * y_vec)
-            s_n = jnp.sqrt(_rsum(s_vec * s_vec))
-            y_n = jnp.sqrt(_rsum(y_vec * y_vec))
-            store = jnp.logical_and(ok, sy > _CAUTIOUS_EPS * s_n * y_n)
-            s_hist = tuple(
-                _sel(store, nxt, old) for nxt, old in
-                zip(st.s_hist[1:] + (s_vec,), st.s_hist))
-            y_hist = tuple(
-                _sel(store, nxt, old) for nxt, old in
-                zip(st.y_hist[1:] + (y_vec,), st.y_hist))
-            rho_shift = jnp.concatenate(
-                [st.rho[1:], jnp.where(sy != 0, 1.0 / sy, 0.0)], axis=0)
-            rho = _sel(store, rho_shift, st.rho)
-            count = jnp.where(store,
-                              jnp.minimum(st.count + 1, m), st.count)
-
-            it_new = st.it + 1
             gnorm_new = jnp.sqrt(_rsum(g_new * g_new))
-            f_delta = jnp.abs(st.f - f_new)
-            reason = jnp.where(
-                ~ok, int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
-                jnp.where(
-                    gnorm_new <= tol * gnorm0,
-                    int(ConvergenceReason.GRADIENT_CONVERGED),
-                    jnp.where(
-                        f_delta <= tol * f0_scale,
-                        int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
-                        jnp.where(it_new >= max_iter,
-                                  int(ConvergenceReason.MAX_ITERATIONS),
-                                  not_conv)))).astype(jnp.int32)
-
-            # Failed line search must not move the iterate.
-            c_new = _sel(ok, c_new, st.c)
-            z_new = _sel(ok, z_new, st.z)
-            f_new = jnp.where(ok, f_new, st.f)
-            g_new = _sel(ok, g_new, st.g)
-            gnorm_new = jnp.where(ok, gnorm_new, st.gnorm)
-
-            # Frozen (converged) lanes keep their previous state.
-            msk = lambda a, b: (jnp.where(active, a, b)
-                                if a.shape == active.shape
-                                else _sel(active, a, b))
-            return _KState(
-                c=msk(c_new, st.c), z=msk(z_new, st.z),
-                f=msk(f_new, st.f), g=msk(g_new, st.g),
-                s_hist=tuple(msk(a, b)
-                             for a, b in zip(s_hist, st.s_hist)),
-                y_hist=tuple(msk(a, b)
-                             for a, b in zip(y_hist, st.y_hist)),
-                rho=msk(rho, st.rho),
-                count=msk(count, st.count),
-                it=msk(it_new, st.it),
-                reason=msk(reason, st.reason),
-                gnorm=msk(gnorm_new, st.gnorm),
-                k=st.k + 1)
+            return finish(st, active, ok, c_new, z_new, f_new, g_new,
+                          gnorm_new)
 
         def cond(st: _KState):
             return jnp.logical_and(st.k < max_iter,
                                    jnp.any(st.reason == not_conv))
 
-        final = lax.while_loop(cond, body, state)
+        final = lax.while_loop(cond, body_owlqn if owlqn else body, state)
 
         out_c_ref[:] = final.c
         out_f_ref[:] = final.f
@@ -316,7 +394,7 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
 @functools.partial(
     jax.jit,
     static_argnames=("loss", "max_iter", "tol", "m", "c1",
-                     "max_line_search", "interpret"))
+                     "max_line_search", "owlqn", "interpret"))
 def pallas_entity_lbfgs(
     loss: PointwiseLoss,
     x: Array,  # [E, r, d]
@@ -325,17 +403,21 @@ def pallas_entity_lbfgs(
     weights: Array,  # [E, r]
     coef0: Array,  # [E, d]
     l2_weight,
+    l1_weight=0.0,
     *,
     max_iter: int = 100,
     tol: float = 1e-7,
     m: int = 10,
     c1: float = 1e-4,
     max_line_search: int = 30,
+    owlqn: bool = False,
     interpret: bool = False,
 ) -> OptimizerResult:
-    """Batched per-entity unconstrained L2 GLM L-BFGS via the fused Pallas
-    kernel. Returns an OptimizerResult with [E]-leading leaves (value /
-    gradient-norm histories are not tracked on this path — None)."""
+    """Batched per-entity unconstrained GLM L-BFGS (or, with
+    ``owlqn=True``, OWL-QN for elastic net — l1_weight then applies) via
+    the fused Pallas kernel. Returns an OptimizerResult with [E]-leading
+    leaves (value / gradient-norm histories are not tracked on this
+    path — None)."""
     e, r, d = x.shape
     dtype = x.dtype
     ep = -(-e // LANES) * LANES
@@ -352,7 +434,8 @@ def pallas_entity_lbfgs(
     c0_l = to_lanes(coef0.astype(dtype), (d,))
 
     kernel = _make_kernel(loss, r=r, max_iter=max_iter, tol=tol, m=m,
-                          c1=c1, max_line_search=max_line_search)
+                          c1=c1, max_line_search=max_line_search,
+                          owlqn=owlqn)
     grid = (ep // LANES,)
 
     def bspec(*trail):
@@ -372,12 +455,15 @@ def pallas_entity_lbfgs(
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # l2 scalar
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # l1 scalar
             bspec(r, d), bspec(r), bspec(r), bspec(r), bspec(d),
         ],
         out_specs=(bspec(d), bspec(1), bspec(1), bspec(1), bspec(1)),
         out_shape=out_shapes,
         interpret=interpret,
-    )(jnp.asarray(l2_weight, dtype).reshape(1), x_l, y_l, off_l, w_l, c0_l)
+    )(jnp.asarray(l2_weight, dtype).reshape(1),
+      jnp.asarray(l1_weight, dtype).reshape(1),
+      x_l, y_l, off_l, w_l, c0_l)
 
     return OptimizerResult(
         x=jnp.moveaxis(c_l, -1, 0)[:e],

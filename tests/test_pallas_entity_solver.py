@@ -242,3 +242,79 @@ def test_pallas_solver_edge_shapes(rng, e, r, d):
     np.testing.assert_allclose(np.asarray(res_k.value),
                                np.asarray(res_v.value),
                                rtol=gold(1e-7, f32_floor=1e-4))
+
+
+def test_pallas_owlqn_matches_vmapped(rng):
+    """Elastic-net (OWL-QN) kernel mode vs the vmapped minimize_owlqn
+    path through solve_glm — values, coefficients, and the SPARSITY
+    pattern (which coordinates are exactly zero) must agree."""
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d = 31, 8, 6
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    obj = GLMObjective(loss)
+    lam, alpha = 1.5, 0.5  # strong l1 so real zeros appear
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=60, tolerance=1e-9, regularization_weight=lam,
+        regularization_context=RegularizationContext(
+            RegularizationType.ELASTIC_NET, alpha))
+
+    res_k = pallas_entity_lbfgs(
+        loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
+        jnp.asarray(w), jnp.zeros((e, d), dtype),
+        (1 - alpha) * lam, alpha * lam,
+        max_iter=60, tol=1e-9, owlqn=True, interpret=True)
+
+    def fit_one(c0, xe, ye, oe, we):
+        return solve_glm(obj, GLMBatch(DenseFeatures(xe), ye, oe, we),
+                         cfg, c0)
+
+    res_v = jax.vmap(fit_one)(jnp.zeros((e, d), dtype), jnp.asarray(x),
+                              jnp.asarray(y), jnp.asarray(off),
+                              jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-7, f32_floor=1e-4))
+    np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
+                               atol=gold(1e-6, f32_floor=5e-3))
+    # exact-zero sets agree (the orthant method's signature behavior)
+    zk = np.asarray(res_k.x) == 0.0
+    zv = np.asarray(res_v.x) == 0.0
+    assert zk.any()  # the l1 weight is strong enough to produce zeros
+    assert np.array_equal(zk, zv)
+
+
+def test_solve_block_routes_elastic_net_through_kernel(monkeypatch, rng):
+    """_solve_block routes ELASTIC_NET configs to the kernel's OWL-QN
+    mode (previously an automatic fallback to the vmapped path)."""
+    from photon_ml_tpu.algorithm.coordinates import _solve_block
+    from photon_ml_tpu.data.random_effect import EntityBlock
+
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d = 19, 5, 4
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    block = EntityBlock(
+        x=jnp.asarray(x), labels=jnp.asarray(y), offsets=jnp.asarray(off),
+        weights=jnp.asarray(w),
+        row_ids=np.zeros((e, r), np.int32),
+        feat_idx=np.broadcast_to(np.arange(d, dtype=np.int32), (e, d)))
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    c0 = jnp.zeros((e, d), dtype)
+
+    def cfg(tol):
+        return GLMOptimizationConfiguration(
+            max_iterations=40, tolerance=tol, regularization_weight=0.8,
+            regularization_context=RegularizationContext(
+                RegularizationType.ELASTIC_NET, 0.5))
+
+    monkeypatch.setenv("PHOTON_ML_TPU_PALLAS_INTERPRET", "1")
+    res_k = _solve_block(obj, cfg(1e-8), block, None, c0)
+    assert res_k.value_history is None  # kernel path ran
+    monkeypatch.delenv("PHOTON_ML_TPU_PALLAS_INTERPRET")
+    monkeypatch.setenv("PHOTON_ML_TPU_NO_PALLAS", "1")
+    res_v = _solve_block(obj, cfg(1.001e-8), block, None, c0)
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-6, f32_floor=1e-4))
+    np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
+                               atol=gold(1e-5, f32_floor=5e-3))
