@@ -548,13 +548,17 @@ def _dispatch_pallas_solver(objective, config, x, labels, offsets,
     OWL-QN mode (matching solve_glm's routing to minimize_owlqn)."""
     from photon_ml_tpu.ops.pallas_entity_solver import pallas_entity_lbfgs
 
+    from photon_ml_tpu.optimization.config import OptimizerType
+
     rc = config.regularization_context
     l1 = rc.l1_weight(config.regularization_weight) if rc else 0.0
     l2 = rc.l2_weight(config.regularization_weight) if rc else 0.0
+    mode = ("tron" if config.optimizer_type == OptimizerType.TRON
+            else "owlqn" if l1 > 0 else "lbfgs")
     return pallas_entity_lbfgs(
         objective.loss, x, labels, offsets, weights, coef0, l2, l1,
         max_iter=config.max_iterations, tol=config.tolerance,
-        owlqn=l1 > 0, interpret=_pallas_interpret())
+        mode=mode, interpret=_pallas_interpret())
 
 
 def _pallas_interpret() -> bool:
@@ -570,9 +574,10 @@ def _use_pallas_entity_solver(objective, config, x,
                               sharded: bool) -> bool:
     """The fused Pallas kernel covers the random-effect solve
     configurations: TPU backend, unconstrained L-BFGS (L2, or OWL-QN
-    when the config carries an L1/elastic-net weight), un-normalized,
-    UNSHARDED dense blocks that fit the kernel's VMEM working set.
-    Everything else stays on the portable vmapped path.
+    when the config carries an L1/elastic-net weight) or TRON
+    (twice-differentiable losses, L2-only), un-normalized, UNSHARDED
+    dense blocks that fit the kernel's VMEM working set. Everything
+    else stays on the portable vmapped path.
 
     ``sharded`` must be decided by the caller at the Python level (the
     coordinate knows whether a mesh shards its blocks) — inside a trace
@@ -591,8 +596,16 @@ def _use_pallas_entity_solver(objective, config, x,
     if (jax.default_backend() != "tpu"
             and not _pallas_interpret()):  # interpret: kernel on any backend
         return False
-    if config.optimizer_type != OptimizerType.LBFGS:
+    if config.optimizer_type not in (OptimizerType.LBFGS,
+                                     OptimizerType.TRON):
         return False
+    if config.optimizer_type == OptimizerType.TRON:
+        rc = config.regularization_context
+        l1 = rc.l1_weight(config.regularization_weight) if rc else 0.0
+        # solve_glm raises for TRON + L1 or a once-differentiable loss;
+        # the vmapped fallback preserves those error contracts.
+        if l1 > 0 or not objective.loss.twice_differentiable:
+            return False
     if objective.normalization is not None:
         return False
     # VMEM working set per 128-entity grid step: the x tile, 2m history
@@ -617,12 +630,11 @@ def _solve_block(
     both stable for a persistent coordinate. The residual gather (the
     reference's addScoresToOffsets join) fuses into the same dispatch.
 
-    On TPU the standard random-effect configurations (L-BFGS/L2 and
-    OWL-QN elastic-net) route to the fused Pallas kernel
+    On TPU the standard random-effect configurations (L-BFGS/L2,
+    OWL-QN elastic-net, and TRON) route to the fused Pallas kernel
     (ops/pallas_entity_solver.py) — the whole per-entity solve as one
     kernel, ~5x over the vmapped op-by-op path; other configurations
-    (TRON, bounds, normalization, CPU) use the portable vmapped
-    solver."""
+    (bounds, normalization, CPU) use the portable vmapped solver."""
     offsets = block.offsets
     extra = _gather_residual(residual_scores, block)
     if extra is not None:

@@ -31,10 +31,11 @@ FIRST Armijo-passing candidate, like optimization/glm_lbfgs.py's batched
 search with its tail folded in).
 
 Routing: algorithm/coordinates.py uses this kernel for random-effect
-bucket solves on TPU — unconstrained L-BFGS with L2, or OWL-QN for
-L1/elastic-net (``owlqn=True``), un-normalized; TRON, bounds,
-normalization and mesh-sharded blocks fall back to the vmapped jnp path.
-Set PHOTON_ML_TPU_NO_PALLAS=1 to disable.
+bucket solves on TPU — unconstrained L-BFGS with L2, OWL-QN for
+L1/elastic-net, or TRON (trust-region Newton-CG, twice-differentiable
+losses), all un-normalized; bounds, normalization and mesh-sharded
+blocks fall back to the vmapped jnp path. Set PHOTON_ML_TPU_NO_PALLAS=1
+to disable.
 """
 
 from __future__ import annotations
@@ -280,6 +281,10 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
                     t = init_step * (shrink ** k)
                     a, x_t, z_t, f_t = trial(t)
                     take = jnp.logical_and(a, ~found)
+                    # 0*inf is NaN in _sel's arithmetic select — an
+                    # overflowed (rejected) trial's margins must not
+                    # poison the carried accumulator.
+                    z_t = jnp.where(jnp.isfinite(z_t), z_t, 0.0)
                     x_acc = _sel(take, x_t, x_acc)
                     z_acc = _sel(take, z_t, z_acc)
                     f_acc = jnp.where(take, f_t, f_acc)
@@ -391,10 +396,220 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
     return kernel
 
 
+
+def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
+                      tol: float, max_cg: int = 20,
+                      max_improvement_failures: int = 5):
+    """TRON (trust-region Newton-CG) per-entity kernel — the same
+    LIBLINEAR rules as optimization/tron.py (sigma/eta constants, radius
+    interpolation, improvement-failure budget), vectorized over lanes
+    with a nested masked CG while-loop. The Gauss-Newton product uses
+    margin-cached curvature weights computed once per outer iteration:
+    Hv = X^T (d2w * (X v)) + l2 v — two r-row sweeps per CG step."""
+    not_conv = np.int32(int(ConvergenceReason.NOT_CONVERGED))
+    ETA0, ETA1, ETA2 = 1e-4, 0.25, 0.75
+    SIG1, SIG2, SIG3 = 0.25, 0.5, 4.0
+    CG_XI = 0.1
+
+    def kernel(l2_ref, l1_ref, x_ref, y_ref, off_ref, w_ref, c0_ref,
+               out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
+               out_reason_ref):
+        del l1_ref  # TRON is L2-only (solve_glm rejects L1+TRON)
+        yv = y_ref[:]
+        off = off_ref[:]
+        w = w_ref[:]
+        l2 = l2_ref[0]
+        x_rows = [x_ref[i] for i in range(r)]
+
+        def margins(c):
+            return jnp.concatenate(
+                [_rsum(x_rows[i] * c) for i in range(r)], axis=0) + off
+
+        def value_from(z, csq):
+            return _rsum(w * loss.loss(z, yv)) + 0.5 * l2 * csq
+
+        def grad_from(c, z):
+            u = w * loss.d1(z, yv)
+            g = l2 * c
+            for i in range(r):
+                g = g + x_rows[i] * u[i:i + 1]
+            return g
+
+        c0 = c0_ref[:]
+        z0 = margins(c0)
+        f0 = value_from(z0, _rsum(c0 * c0))
+        g0 = grad_from(c0, z0)
+        gnorm0 = jnp.sqrt(_rsum(g0 * g0))
+        f0_scale = jnp.maximum(jnp.abs(f0), 1e-30)
+
+        # (c, z, f, g, delta, it, fails, reason, gnorm, first, k)
+        state = (c0, z0, f0, g0, gnorm0,
+                 jnp.zeros((1, c0.shape[1]), jnp.int32),
+                 jnp.zeros((1, c0.shape[1]), jnp.int32),
+                 jnp.where(gnorm0 <= 0.0,
+                           int(ConvergenceReason.GRADIENT_CONVERGED),
+                           not_conv).astype(jnp.int32),
+                 gnorm0,
+                 jnp.ones((1, c0.shape[1]), jnp.int32),
+                 jnp.zeros((), jnp.int32))
+
+        def body(st):
+            (c, z, f, g, delta, it, fails, reason, gnorm, first, k) = st
+            active = reason == not_conv
+
+            # Curvature weights once per outer iteration (margin-cached).
+            d2w = w * loss.d2(z, yv)  # [r, L]
+
+            def hvp(v):
+                u = jnp.concatenate(
+                    [_rsum(x_rows[i] * v) for i in range(r)], axis=0)
+                u = d2w * u
+                hv = l2 * v
+                for i in range(r):
+                    hv = hv + x_rows[i] * u[i:i + 1]
+                return hv
+
+            # Steihaug-Toint truncated CG, per-lane masked (mirrors
+            # _truncated_cg in optimization/tron.py).
+            stop_norm = CG_XI * jnp.sqrt(_rsum(g * g))
+
+            def cg_body(cg):
+                s, rres, dvec, rtr, kk, done = cg
+                hd = hvp(dvec)
+                dhd = _rsum(dvec * hd)
+                alpha = rtr / jnp.where(dhd > 0, dhd, 1.0)
+                s_try = s + alpha * dvec
+                crossed = jnp.logical_or(
+                    _rsum(s_try * s_try) > delta * delta, dhd <= 0)
+                std = _rsum(s * dvec)
+                dd = _rsum(dvec * dvec)
+                ss = _rsum(s * s)
+                gap = jnp.maximum(delta * delta - ss, 0.0)
+                rad = jnp.sqrt(jnp.maximum(std * std + dd * gap, 0.0))
+                tau = jnp.where(std >= 0,
+                                gap / jnp.maximum(std + rad, 1e-30),
+                                (rad - std) / jnp.maximum(dd, 1e-30))
+                step = jnp.where(crossed, tau, alpha)
+                s_new = s + step * dvec
+                r_new = rres - step * hd
+                rtr_new = _rsum(r_new * r_new)
+                beta = rtr_new / jnp.maximum(rtr, 1e-30)
+                d_new = r_new + beta * dvec
+                done_new = jnp.logical_or(
+                    crossed, jnp.sqrt(rtr_new) <= stop_norm)
+                sel2 = lambda a, b: _sel(done, b, a)  # frozen lanes keep b
+                return (sel2(s_new, s), sel2(r_new, rres),
+                        sel2(d_new, dvec), jnp.where(done, rtr, rtr_new),
+                        kk + 1, jnp.logical_or(done, done_new))
+
+            def cg_cond(cg):
+                return jnp.logical_and(cg[4] < max_cg,
+                                       jnp.any(~cg[5]))
+
+            # Frozen (converged) lanes start CG done — their results are
+            # discarded by the outer mask, so running their Hv sweeps
+            # would only stretch the lockstep loop for the whole group.
+            cg0 = (g * 0.0, -g, -g, _rsum(g * g), jnp.zeros((), jnp.int32),
+                   jnp.logical_or(~active,
+                                  jnp.sqrt(_rsum(g * g)) <= stop_norm))
+            s, rres, *_ = lax.while_loop(cg_cond, cg_body, cg0)
+
+            c_try = c + s
+            z_try = margins(c_try)
+            f_new = value_from(z_try, _rsum(c_try * c_try))
+            g_new = grad_from(c_try, z_try)
+
+            gs = _rsum(g * s)
+            prered = -0.5 * (gs - _rsum(s * rres))
+            actred = f - f_new
+            snorm = jnp.sqrt(_rsum(s * s))
+
+            delta_n = jnp.where(first > 0, jnp.minimum(delta, snorm), delta)
+            denom = f_new - f - gs
+            alpha_i = jnp.where(
+                denom <= 0, SIG3,
+                jnp.maximum(SIG1, -0.5 * (gs / jnp.maximum(denom, 1e-30))))
+            alpha_s = alpha_i * snorm
+            delta_n = jnp.where(
+                actred < ETA0 * prered,
+                jnp.minimum(jnp.maximum(alpha_i, SIG1) * snorm,
+                            SIG2 * delta_n),
+                jnp.where(
+                    actred < ETA1 * prered,
+                    jnp.maximum(SIG1 * delta_n,
+                                jnp.minimum(alpha_s, SIG2 * delta_n)),
+                    jnp.where(
+                        actred < ETA2 * prered,
+                        jnp.maximum(SIG1 * delta_n,
+                                    jnp.minimum(alpha_s, SIG3 * delta_n)),
+                        jnp.maximum(delta_n,
+                                    jnp.minimum(alpha_s, SIG3 * delta_n)))))
+
+            accept = jnp.logical_and(actred > ETA0 * prered,
+                                     jnp.isfinite(f_new))
+            it_n = it + jnp.where(accept, 1, 0).astype(jnp.int32)
+            fails_n = jnp.where(accept, 0, fails + 1).astype(jnp.int32)
+
+            # Sanitize non-finite trial values before the arithmetic
+            # keep-old selects: _sel computes b + m*(a-b), and 0*inf is
+            # NaN — an overflowed rejected trial must not poison the
+            # retained iterate (the vmapped path's jnp.where is immune;
+            # a rejected lane never accepts these zeros).
+            z_try = jnp.where(jnp.isfinite(z_try), z_try, 0.0)
+            g_new = jnp.where(jnp.isfinite(g_new), g_new, 0.0)
+            f_new = jnp.where(jnp.isfinite(f_new), f_new, 0.0)
+
+            c_acc = _sel(accept, c_try, c)
+            z_acc = _sel(accept, z_try, z)
+            f_acc = jnp.where(accept, f_new, f)
+            g_acc = _sel(accept, g_new, g)
+            gnorm_acc = jnp.sqrt(_rsum(g_acc * g_acc))
+            f_delta = jnp.abs(f - f_acc)
+
+            reason_n = jnp.where(
+                fails_n > max_improvement_failures,
+                int(ConvergenceReason.OBJECTIVE_NOT_IMPROVING),
+                jnp.where(
+                    jnp.logical_and(accept, gnorm_acc <= tol * gnorm0),
+                    int(ConvergenceReason.GRADIENT_CONVERGED),
+                    jnp.where(
+                        jnp.logical_and(accept, f_delta <= tol * f0_scale),
+                        int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
+                        jnp.where(it_n >= max_iter,
+                                  int(ConvergenceReason.MAX_ITERATIONS),
+                                  not_conv)))).astype(jnp.int32)
+
+            msk = lambda a, b: (jnp.where(active, a, b)
+                                if a.shape == active.shape
+                                else _sel(active, a, b))
+            return (msk(c_acc, c), msk(z_acc, z), msk(f_acc, f),
+                    msk(g_acc, g), msk(delta_n, delta), msk(it_n, it),
+                    msk(fails_n, fails), msk(reason_n, reason),
+                    msk(gnorm_acc, gnorm),
+                    msk(jnp.zeros_like(first), first), k + 1)
+
+        def cond(st):
+            # Outer trip bound: every non-accepted iteration burns one of
+            # max_improvement_failures+1 budget, so the host's unbounded
+            # while terminates within this many trips.
+            trips = max_iter * (max_improvement_failures + 2)
+            return jnp.logical_and(st[10] < trips,
+                                   jnp.any(st[7] == not_conv))
+
+        final = lax.while_loop(cond, body, state)
+        out_c_ref[:] = final[0]
+        out_f_ref[:] = final[2]
+        out_gnorm_ref[:] = final[8]
+        out_it_ref[:] = final[5]
+        out_reason_ref[:] = final[7]
+
+    return kernel
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("loss", "max_iter", "tol", "m", "c1",
-                     "max_line_search", "owlqn", "interpret"))
+                     "max_line_search", "mode", "interpret"))
 def pallas_entity_lbfgs(
     loss: PointwiseLoss,
     x: Array,  # [E, r, d]
@@ -410,12 +625,13 @@ def pallas_entity_lbfgs(
     m: int = 10,
     c1: float = 1e-4,
     max_line_search: int = 30,
-    owlqn: bool = False,
+    mode: str = "lbfgs",
     interpret: bool = False,
 ) -> OptimizerResult:
-    """Batched per-entity unconstrained GLM L-BFGS (or, with
-    ``owlqn=True``, OWL-QN for elastic net — l1_weight then applies) via
-    the fused Pallas kernel. Returns an OptimizerResult with [E]-leading
+    """Batched per-entity unconstrained GLM solve via the fused Pallas
+    kernel. ``mode``: "lbfgs" (L2), "owlqn" (elastic net — l1_weight
+    applies), or "tron" (trust-region Newton-CG, L2, reference defaults
+    for the CG budget). Returns an OptimizerResult with [E]-leading
     leaves (value / gradient-norm histories are not tracked on this
     path — None)."""
     e, r, d = x.shape
@@ -433,9 +649,15 @@ def pallas_entity_lbfgs(
     w_l = to_lanes(weights.astype(dtype), (r,))  # pad weights are 0
     c0_l = to_lanes(coef0.astype(dtype), (d,))
 
-    kernel = _make_kernel(loss, r=r, max_iter=max_iter, tol=tol, m=m,
-                          c1=c1, max_line_search=max_line_search,
-                          owlqn=owlqn)
+    if mode == "tron":
+        kernel = _make_tron_kernel(loss, r=r, max_iter=max_iter, tol=tol)
+    elif mode in ("lbfgs", "owlqn"):
+        kernel = _make_kernel(loss, r=r, max_iter=max_iter, tol=tol, m=m,
+                              c1=c1, max_line_search=max_line_search,
+                              owlqn=mode == "owlqn")
+    else:
+        raise ValueError(f"unknown mode {mode!r}: "
+                         "expected lbfgs | owlqn | tron")
     grid = (ep // LANES,)
 
     def bspec(*trail):

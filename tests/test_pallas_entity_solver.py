@@ -17,6 +17,7 @@ from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.ops.pallas_entity_solver import pallas_entity_lbfgs
 from photon_ml_tpu.optimization.config import (
     GLMOptimizationConfiguration,
+    OptimizerType,
     RegularizationContext,
     RegularizationType,
 )
@@ -263,7 +264,7 @@ def test_pallas_owlqn_matches_vmapped(rng):
         loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
         jnp.asarray(w), jnp.zeros((e, d), dtype),
         (1 - alpha) * lam, alpha * lam,
-        max_iter=60, tol=1e-9, owlqn=True, interpret=True)
+        max_iter=60, tol=1e-9, mode="owlqn", interpret=True)
 
     def fit_one(c0, xe, ye, oe, we):
         return solve_glm(obj, GLMBatch(DenseFeatures(xe), ye, oe, we),
@@ -318,3 +319,130 @@ def test_solve_block_routes_elastic_net_through_kernel(monkeypatch, rng):
                                rtol=gold(1e-6, f32_floor=1e-4))
     np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
                                atol=gold(1e-5, f32_floor=5e-3))
+
+
+@pytest.mark.parametrize("task", [TaskType.LOGISTIC_REGRESSION,
+                                  TaskType.POISSON_REGRESSION,
+                                  TaskType.LINEAR_REGRESSION])
+def test_pallas_tron_matches_vmapped(rng, task):
+    """TRON kernel mode vs the vmapped minimize_tron path through
+    solve_glm (LIBLINEAR trust-region rules, truncated CG)."""
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d = 29, 7, 5
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    if task == TaskType.POISSON_REGRESSION:
+        y = rng.poisson(2.0, (e, r)).astype(dtype)
+    elif task == TaskType.LINEAR_REGRESSION:
+        y = rng.normal(0, 1, (e, r)).astype(dtype)
+    loss = loss_for_task(task)
+    obj = GLMObjective(loss)
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=15, tolerance=1e-7, regularization_weight=0.5,
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        optimizer_type=OptimizerType.TRON)
+
+    res_k = pallas_entity_lbfgs(
+        loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
+        jnp.asarray(w), jnp.zeros((e, d), dtype), 0.5,
+        max_iter=15, tol=1e-7, mode="tron", interpret=True)
+
+    def fit_one(c0, xe, ye, oe, we):
+        return solve_glm(obj, GLMBatch(DenseFeatures(xe), ye, oe, we),
+                         cfg, c0)
+
+    res_v = jax.vmap(fit_one)(jnp.zeros((e, d), dtype), jnp.asarray(x),
+                              jnp.asarray(y), jnp.asarray(off),
+                              jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-7, f32_floor=2e-4))
+    np.testing.assert_allclose(np.asarray(res_k.x), np.asarray(res_v.x),
+                               atol=gold(1e-4, f32_floor=1e-2))
+
+
+def test_solve_block_routes_tron_through_kernel(monkeypatch, rng):
+    """TRON random-effect configs reach the kernel; once-differentiable
+    losses keep the vmapped fallback (which raises solve_glm's error)."""
+    from photon_ml_tpu.algorithm.coordinates import (
+        _solve_block,
+        _use_pallas_entity_solver,
+    )
+    from photon_ml_tpu.data.random_effect import EntityBlock
+
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d = 13, 4, 3
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    block = EntityBlock(
+        x=jnp.asarray(x), labels=jnp.asarray(y), offsets=jnp.asarray(off),
+        weights=jnp.asarray(w),
+        row_ids=np.zeros((e, r), np.int32),
+        feat_idx=np.broadcast_to(np.arange(d, dtype=np.int32), (e, d)))
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    c0 = jnp.zeros((e, d), dtype)
+
+    def cfg(tol):
+        return GLMOptimizationConfiguration(
+            max_iterations=12, tolerance=tol, regularization_weight=0.4,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2),
+            optimizer_type=OptimizerType.TRON)
+
+    monkeypatch.setenv("PHOTON_ML_TPU_PALLAS_INTERPRET", "1")
+    res_k = _solve_block(obj, cfg(1e-7), block, None, c0)
+    assert res_k.value_history is None  # kernel path ran
+    monkeypatch.delenv("PHOTON_ML_TPU_PALLAS_INTERPRET")
+    monkeypatch.setenv("PHOTON_ML_TPU_NO_PALLAS", "1")
+    res_v = _solve_block(obj, cfg(1.001e-7), block, None, c0)
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-6, f32_floor=1e-4))
+
+    # Guard: TRON + once-differentiable loss never routes to the kernel.
+    hinge_obj = GLMObjective(
+        loss_for_task(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM))
+    assert not _use_pallas_entity_solver(hinge_obj, cfg(1e-7), block.x,
+                                         sharded=False)
+
+
+@pytest.mark.parametrize("mode", ["tron", "owlqn"])
+def test_pallas_solver_overflow_trials_stay_finite(rng, mode):
+    """Rejected trial steps whose margins overflow exp must not poison
+    the retained iterate (the arithmetic keep-old select computes
+    b + m*(a-b), and 0*inf is NaN): Poisson with huge feature scale
+    forces non-finite trial values; results must stay finite and match
+    the vmapped solver."""
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d = 7, 6, 3
+    x = (rng.normal(0, 1, (e, r, d)) * 300.0).astype(dtype)
+    y = rng.poisson(3.0, (e, r)).astype(dtype)
+    off = np.zeros((e, r), dtype)
+    w = np.ones((e, r), dtype)
+    loss = loss_for_task(TaskType.POISSON_REGRESSION)
+    obj = GLMObjective(loss)
+    reg = (RegularizationContext(RegularizationType.L2) if mode == "tron"
+           else RegularizationContext(RegularizationType.ELASTIC_NET, 0.5))
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=12, tolerance=1e-7, regularization_weight=0.5,
+        regularization_context=reg,
+        optimizer_type=(OptimizerType.TRON if mode == "tron"
+                        else OptimizerType.LBFGS))
+    l1 = 0.25 if mode == "owlqn" else 0.0
+    l2 = 0.5 if mode == "tron" else 0.25
+
+    res_k = pallas_entity_lbfgs(
+        loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
+        jnp.asarray(w), jnp.zeros((e, d), dtype), l2, l1,
+        max_iter=12, tol=1e-7, mode=mode, interpret=True)
+    assert np.isfinite(np.asarray(res_k.x)).all()
+    assert np.isfinite(np.asarray(res_k.value)).all()
+
+    def fit_one(c0, xe, ye, oe, we):
+        return solve_glm(obj, GLMBatch(DenseFeatures(xe), ye, oe, we),
+                         cfg, c0)
+
+    res_v = jax.vmap(fit_one)(jnp.zeros((e, d), dtype), jnp.asarray(x),
+                              jnp.asarray(y), jnp.asarray(off),
+                              jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(res_k.value),
+                               np.asarray(res_v.value),
+                               rtol=gold(1e-6, f32_floor=2e-4))
