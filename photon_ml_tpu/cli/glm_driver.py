@@ -123,6 +123,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated listener class paths")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "float64"])
+    p.add_argument("--feature-storage-dtype", default=None,
+                   choices=["bfloat16"],
+                   help="store DENSE features at half width (bfloat16) "
+                        "with solver-dtype accumulation — ~2x on the "
+                        "bandwidth-bound fixed-effect solve; see "
+                        "docs/F32_PARITY.md for the precision bounds")
     p.add_argument("--profile-output-dir", default=None,
                    help="write a jax.profiler trace of the train phase here "
                         "(view with XProf/TensorBoard)")
@@ -408,7 +414,10 @@ def run(argv=None) -> dict:
             lower_bounds=lb, upper_bounds=ub,
             warm_start=args.warm_start == "true",
             compute_variances=args.compute_variance == "true",
-            dtype=dtype)
+            dtype=dtype,
+            storage_dtype=(jnp.bfloat16
+                           if args.feature_storage_dtype == "bfloat16"
+                           else None))
     stages.append("TRAINED")
     for t in trained:
         emitter.send_event(PhotonOptimizationLogEvent(
@@ -467,7 +476,10 @@ def run(argv=None) -> dict:
                     max_iterations=args.max_num_iterations,
                     tolerance=args.tolerance, normalization=norm,
                     lower_bounds=lb, upper_bounds=ub,
-                    warm_start=args.warm_start == "true", dtype=dtype),
+                    warm_start=args.warm_start == "true", dtype=dtype,
+                    storage_dtype=(jnp.bfloat16
+                                   if args.feature_storage_dtype
+                                   == "bfloat16" else None)),
                 num_bootstrap_samples=args.num_bootstrap_samples)
         stages.append("DIAGNOSED")
         logger.info("diagnostics written to model-diagnostic.{json,html}")

@@ -84,12 +84,16 @@ def train_glm_models(
     warm_start: bool = True,
     compute_variances: bool = False,
     dtype=jnp.float64,
+    storage_dtype=None,
     initial_model: Optional[GeneralizedLinearModel] = None,
     track_models: bool = False,
 ) -> List[TrainedGLM]:
     """Train one GLM per λ, descending, warm-started. Returns grid order
-    as given (the reference reports models keyed by λ)."""
-    batch = device_batch(features, labels, offsets, weights, dtype=dtype)
+    as given (the reference reports models keyed by λ).
+    ``storage_dtype=jnp.bfloat16`` stores dense features at half width
+    (solver-dtype accumulation — see DenseFeatures)."""
+    batch = device_batch(features, labels, offsets, weights, dtype=dtype,
+                         storage_dtype=storage_dtype)
     d = batch.features.num_features
     objective = GLMObjective(loss_for_task(task), normalization)
     glm_cls = model_for_task(task)

@@ -767,5 +767,12 @@ def features_to_device(mat, dtype=jnp.float32,
         density = mat.nnz / max(1, mat.shape[0] * mat.shape[1])
         if density >= dense_threshold:
             return DenseFeatures(jnp.asarray(mat.toarray(), dense_dt))
+        if storage_dtype is not None:
+            import logging
+
+            logging.getLogger("photon_ml_tpu").warning(
+                "storage_dtype=%s ignored: density %.3f < %.2f selects the "
+                "CSR layout (sparse layouts are lookup-count-bound, not "
+                "byte-bound)", storage_dtype, density, dense_threshold)
         return csr_from_scipy(mat, dtype=dtype)
     return DenseFeatures(jnp.asarray(np.asarray(mat), dense_dt))

@@ -345,3 +345,39 @@ def test_multihost_initialize_noop_single_host():
 
     assert initialize_multihost() is False  # no coordinator env -> no-op
     assert is_primary_host() is True
+
+
+def test_glm_driver_bf16_feature_storage(tmp_path, rng):
+    """--feature-storage-dtype bfloat16 trains end-to-end and reaches the
+    same validation quality as full-width storage (predictions carry
+    bf16's ~3 digits; AUC is insensitive at this scale)."""
+    train = tmp_path / "train"
+    valid = tmp_path / "valid"
+    w_true = rng.normal(0, 1, 6)
+    _write_glm_avro(train, rng, n=300, w=w_true)
+    _write_glm_avro(valid, rng, n=100, w=w_true)
+
+    def run(extra):
+        out = tmp_path / ("out-" + ("bf16" if extra else "f32"))
+        summary = glm_driver.run([
+            "--training-data-directory", str(train),
+            "--validating-data-directory", str(valid),
+            "--output-directory", str(out),
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "1",
+            "--max-num-iterations", "60",
+        ] + extra)
+        return summary["validationMetrics"]["1.0"]["AUC"]
+
+    auc32 = run([])
+    auc16 = run(["--feature-storage-dtype", "bfloat16"])
+    assert auc32 > 0.6  # both models genuinely learned
+    assert abs(auc16 - auc32) < 0.02
+    # the flag actually engaged: this dense 6-feature matrix must pick
+    # the DenseFeatures layout and store bf16
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.features import features_to_device
+
+    feats = features_to_device(np.ones((4, 6)), storage_dtype=jnp.bfloat16)
+    assert feats.x.dtype == jnp.bfloat16
