@@ -1,1 +1,28 @@
 """Command-line drivers (reference: ml/Driver.scala, ml/cli/game/)."""
+
+
+def _honor_jax_platforms_env() -> None:
+    """Make JAX_PLATFORMS authoritative for driver processes.
+
+    Some environments install a sitecustomize that registers extra JAX
+    platforms and overrides the platform selection at import time (e.g.
+    a remote-TPU plugin forcing "tpu,cpu"); the env var alone is then
+    silently ignored and a CPU-intended run hangs on remote-device init.
+    Re-asserting the env value through jax.config before first backend
+    use restores the documented env-var contract. No-op when the var is
+    unset or backends are already initialized."""
+    import os
+
+    val = os.environ.get("JAX_PLATFORMS")
+    if not val:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", val)
+    except Exception as e:  # noqa: BLE001 - never block a driver, but say so
+        import logging
+
+        logging.getLogger("photon_ml_tpu").warning(
+            "could not apply JAX_PLATFORMS=%s (%s) — the run may not use "
+            "the intended backend", val, e)

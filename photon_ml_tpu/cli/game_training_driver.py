@@ -116,6 +116,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def run(argv=None) -> dict:
+    from photon_ml_tpu.cli import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
     out_dir = Path(args.output_dir)
     out_dir.mkdir(parents=True, exist_ok=True)

@@ -310,6 +310,9 @@ def _run_diagnostics(mode, out_dir, task, trained, metrics_by_lambda,
 
 
 def run(argv=None) -> dict:
+    from photon_ml_tpu.cli import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
     out_dir = Path(args.output_directory)
     out_dir.mkdir(parents=True, exist_ok=True)
@@ -334,6 +337,8 @@ def run(argv=None) -> dict:
         # and the whole solve runs at the wrong precision.
         jax.config.update("jax_enable_x64", True)
     dtype = jnp.float64 if args.dtype == "float64" else jnp.float32
+    storage_dtype = (jnp.bfloat16
+                     if args.feature_storage_dtype == "bfloat16" else None)
 
     # ---- preprocess ------------------------------------------------------
     with timer.time("preprocess"):
@@ -415,9 +420,7 @@ def run(argv=None) -> dict:
             warm_start=args.warm_start == "true",
             compute_variances=args.compute_variance == "true",
             dtype=dtype,
-            storage_dtype=(jnp.bfloat16
-                           if args.feature_storage_dtype == "bfloat16"
-                           else None))
+            storage_dtype=storage_dtype)
     stages.append("TRAINED")
     for t in trained:
         emitter.send_event(PhotonOptimizationLogEvent(
@@ -477,9 +480,7 @@ def run(argv=None) -> dict:
                     tolerance=args.tolerance, normalization=norm,
                     lower_bounds=lb, upper_bounds=ub,
                     warm_start=args.warm_start == "true", dtype=dtype,
-                    storage_dtype=(jnp.bfloat16
-                                   if args.feature_storage_dtype
-                                   == "bfloat16" else None)),
+                    storage_dtype=storage_dtype),
                 num_bootstrap_samples=args.num_bootstrap_samples)
         stages.append("DIAGNOSED")
         logger.info("diagnostics written to model-diagnostic.{json,html}")

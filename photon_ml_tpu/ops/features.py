@@ -768,11 +768,14 @@ def features_to_device(mat, dtype=jnp.float32,
         if density >= dense_threshold:
             return DenseFeatures(jnp.asarray(mat.toarray(), dense_dt))
         if storage_dtype is not None:
-            import logging
+            import warnings
 
-            logging.getLogger("photon_ml_tpu").warning(
-                "storage_dtype=%s ignored: density %.3f < %.2f selects the "
-                "CSR layout (sparse layouts are lookup-count-bound, not "
-                "byte-bound)", storage_dtype, density, dense_threshold)
+            # warnings (not logging): default dedup — diagnostics re-ingest
+            # per bootstrap/fitting subset and one line per JOB is enough.
+            warnings.warn(
+                f"storage_dtype={storage_dtype} ignored: density "
+                f"{density:.3f} < {dense_threshold:.2f} selects the CSR "
+                "layout (sparse layouts are lookup-count-bound, not "
+                "byte-bound)", stacklevel=2)
         return csr_from_scipy(mat, dtype=dtype)
     return DenseFeatures(jnp.asarray(np.asarray(mat), dense_dt))

@@ -369,15 +369,25 @@ def test_glm_driver_bf16_feature_storage(tmp_path, rng):
         ] + extra)
         return summary["validationMetrics"]["1.0"]["AUC"]
 
-    auc32 = run([])
-    auc16 = run(["--feature-storage-dtype", "bfloat16"])
-    assert auc32 > 0.6  # both models genuinely learned
-    assert abs(auc16 - auc32) < 0.02
-    # the flag actually engaged: this dense 6-feature matrix must pick
-    # the DenseFeatures layout and store bf16
+    # The flag must actually reach the ingest chooser THROUGH the driver:
+    # capture what train_glm_models hands to device_batch.
     import jax.numpy as jnp
 
-    from photon_ml_tpu.ops.features import features_to_device
+    from photon_ml_tpu.estimators import model_training
 
-    feats = features_to_device(np.ones((4, 6)), storage_dtype=jnp.bfloat16)
-    assert feats.x.dtype == jnp.bfloat16
+    seen = []
+    orig = model_training.device_batch
+
+    def spy(*a, **kw):
+        seen.append(kw.get("storage_dtype"))
+        return orig(*a, **kw)
+
+    model_training.device_batch, saved = spy, orig
+    try:
+        auc32 = run([])
+        auc16 = run(["--feature-storage-dtype", "bfloat16"])
+    finally:
+        model_training.device_batch = saved
+    assert auc32 > 0.6  # both models genuinely learned
+    assert abs(auc16 - auc32) < 0.02
+    assert seen == [None, jnp.bfloat16]
