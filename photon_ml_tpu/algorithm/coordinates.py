@@ -38,6 +38,7 @@ from photon_ml_tpu.ops.features import KroneckerFeatures
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.convergence import OptimizerResult
 from photon_ml_tpu.optimization.solver import solve_glm
 from photon_ml_tpu.types import TaskType
 
@@ -257,7 +258,7 @@ class RandomEffectCoordinate(Coordinate):
         for block, coefs in zip(self.dataset.blocks, model.local_coefs):
             result = _solve_block(
                 self._objective, self.config, block, residual_scores, coefs,
-                sharded=self.mesh is not None)
+                sharded=self.mesh is not None, mesh=self.mesh)
             new_coefs.append(result.x)
             trackers.append(result)
         return model.with_coefs(new_coefs), trackers
@@ -291,7 +292,7 @@ class RandomEffectCoordinate(Coordinate):
         blocks, _ = data
         results = [
             _solve_block(self._objective, self.config, block, residual, c0,
-                         sharded=self.mesh is not None)
+                         sharded=self.mesh is not None, mesh=self.mesh)
             for block, c0 in zip(blocks, params)]
         return tuple(r.x for r in results), list(results)
 
@@ -575,9 +576,13 @@ def _use_pallas_entity_solver(objective, config, x,
     """The fused Pallas kernel covers the random-effect solve
     configurations: TPU backend, unconstrained L-BFGS (L2, or OWL-QN
     when the config carries an L1/elastic-net weight) or TRON
-    (twice-differentiable losses, L2-only), un-normalized, UNSHARDED
-    dense blocks that fit the kernel's VMEM working set. Everything
-    else stays on the portable vmapped path.
+    (twice-differentiable losses, L2-only), un-normalized dense blocks
+    that fit the kernel's VMEM working set. Mesh-sharded blocks are
+    ALSO kernel-eligible — _solve_block wraps the kernel in shard_map
+    (one kernel per device over its entity shard) and passes
+    sharded=False here to express that; sharded=True means "sharded
+    with no mesh to scope a per-device kernel" and falls back to the
+    portable vmapped path, as do all other configurations.
 
     ``sharded`` must be decided by the caller at the Python level (the
     coordinate knows whether a mesh shards its blocks) — inside a trace
@@ -619,10 +624,11 @@ def _use_pallas_entity_solver(objective, config, x,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("objective", "config", "sharded"))
+    jax.jit, static_argnames=("objective", "config", "sharded", "mesh"))
 def _solve_block(
     objective: GLMObjective, config: GLMOptimizationConfiguration,
     block: EntityBlock, residual_scores, coefs0, sharded: bool = False,
+    mesh=None,
 ):
     """One batched solve over the bucket's entity axis, jitted so the whole
     batched solve (trace included) is cached across coordinate-descent
@@ -633,14 +639,44 @@ def _solve_block(
     On TPU the standard random-effect configurations (L-BFGS/L2,
     OWL-QN elastic-net, and TRON) route to the fused Pallas kernel
     (ops/pallas_entity_solver.py) — the whole per-entity solve as one
-    kernel, ~5x over the vmapped op-by-op path; other configurations
-    (bounds, normalization, CPU) use the portable vmapped solver."""
+    kernel, ~5x over the vmapped op-by-op path. With a mesh, the kernel
+    runs per device over the entity-sharded bucket via ``shard_map``
+    (each device solves its own 1/n of the entities — entity sharding
+    composed with the kernel; sentinel padding entities converge
+    instantly). Other configurations (bounds, normalization, CPU) use
+    the portable vmapped solver."""
     offsets = block.offsets
     extra = _gather_residual(residual_scores, block)
     if extra is not None:
         offsets = offsets + extra.astype(offsets.dtype)
 
-    if _use_pallas_entity_solver(objective, config, block.x, sharded):
+    # With a mesh the kernel is still eligible — it runs per device via
+    # shard_map below — so the "sharded" rejection only applies when no
+    # mesh is available to scope it.
+    use_kernel = _use_pallas_entity_solver(
+        objective, config, block.x, sharded=sharded and mesh is None)
+
+    if use_kernel and sharded and mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        s2, s3 = P("data", None), P("data", None, None)
+        out_specs = OptimizerResult(
+            x=s2, value=P("data"), grad_norm=P("data"),
+            iterations=P("data"), reason=P("data"),
+            value_history=None, grad_norm_history=None, coef_history=None)
+
+        def local_solve(x, labels, off, w, c0):
+            return _dispatch_pallas_solver(objective, config, x, labels,
+                                           off, w, c0)
+
+        return jax.shard_map(
+            local_solve, mesh=mesh,
+            in_specs=(s3, s2, s2, s2, s2), out_specs=out_specs,
+            # pallas_call's out_shapes carry no varying-mesh-axes info
+            check_vma=False,
+        )(block.x, block.labels, offsets, block.weights, coefs0)
+
+    if use_kernel:
         return _dispatch_pallas_solver(objective, config, block.x,
                                        block.labels, offsets,
                                        block.weights, coefs0)

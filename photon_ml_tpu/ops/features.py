@@ -772,10 +772,12 @@ def features_to_device(mat, dtype=jnp.float32,
 
             # warnings (not logging): default dedup — diagnostics re-ingest
             # per bootstrap/fitting subset and one line per JOB is enough.
+            # The message must be CONSTANT (dedup keys on text), so the
+            # varying density stays out of it.
             warnings.warn(
-                f"storage_dtype={storage_dtype} ignored: density "
-                f"{density:.3f} < {dense_threshold:.2f} selects the CSR "
-                "layout (sparse layouts are lookup-count-bound, not "
-                "byte-bound)", stacklevel=2)
+                f"storage_dtype={storage_dtype} ignored: data density is "
+                f"below the dense threshold ({dense_threshold:.2f}), which "
+                "selects the CSR layout (sparse layouts are "
+                "lookup-count-bound, not byte-bound)", stacklevel=2)
         return csr_from_scipy(mat, dtype=dtype)
     return DenseFeatures(jnp.asarray(np.asarray(mat), dense_dt))
