@@ -439,7 +439,7 @@ class FactoredRandomEffectCoordinate(Coordinate):
             gammas = [
                 _solve_factored_block(
                     self._objective, self.config, block, B, extra, g0, d,
-                    sharded=self.mesh is not None).x
+                    sharded=self.mesh is not None, mesh=self.mesh).x
                 for block, extra, g0 in zip(blocks, residuals, gammas)]
             batch = GLMBatch(
                 KroneckerFeatures(x_flat, _flatten_gammas(blocks, gammas)),
@@ -465,21 +465,31 @@ class FactoredRandomEffectCoordinate(Coordinate):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("objective", "config", "d", "sharded"))
+    jax.jit,
+    static_argnames=("objective", "config", "d", "sharded", "mesh"))
 def _solve_factored_block(
     objective: GLMObjective, config: GLMOptimizationConfiguration,
     block: EntityBlock, B, extra_offsets, gamma0, d: int,
-    sharded: bool = False,
+    sharded: bool = False, mesh=None,
 ):
     """Per-entity latent solves against the current B: one projection einsum
     for the whole bucket, then the batched solve (fused Pallas kernel on
     TPU — the latent bucket has the same shape contract as the
-    random-effect one, see _solve_block)."""
+    random-effect one, see _solve_block; with a mesh the kernel runs per
+    device over the entity-sharded bucket via shard_map, B replicated)."""
     lat = jnp.einsum("end,kd->enk", block.x[..., :d], B)
     offsets = block.offsets if extra_offsets is None else \
         block.offsets + extra_offsets.astype(block.offsets.dtype)
 
-    if _use_pallas_entity_solver(objective, config, lat, sharded):
+    use_kernel = _use_pallas_entity_solver(
+        objective, config, lat, sharded=sharded and mesh is None)
+
+    if use_kernel and sharded and mesh is not None:
+        return _shard_mapped_pallas_solver(
+            objective, config, mesh, lat, block.labels, offsets,
+            block.weights, gamma0)
+
+    if use_kernel:
         return _dispatch_pallas_solver(objective, config, lat,
                                        block.labels, offsets,
                                        block.weights, gamma0)
@@ -560,6 +570,33 @@ def _dispatch_pallas_solver(objective, config, x, labels, offsets,
         objective.loss, x, labels, offsets, weights, coef0, l2, l1,
         max_iter=config.max_iterations, tol=config.tolerance,
         mode=mode, interpret=_pallas_interpret())
+
+
+def _shard_mapped_pallas_solver(objective, config, mesh, x, labels,
+                                offsets, weights, coef0):
+    """Entity-sharded kernel dispatch: one fused kernel per device over
+    its shard of the entity axis, results reassembled under the same
+    sharding. One implementation for the random-effect and
+    factored-latent paths (same non-divergence contract as
+    _dispatch_pallas_solver)."""
+    from jax.sharding import PartitionSpec as P
+
+    s2, s3 = P("data", None), P("data", None, None)
+    out_specs = OptimizerResult(
+        x=s2, value=P("data"), grad_norm=P("data"),
+        iterations=P("data"), reason=P("data"),
+        value_history=None, grad_norm_history=None, coef_history=None)
+
+    def local_solve(x_l, labels_l, off_l, w_l, c0_l):
+        return _dispatch_pallas_solver(objective, config, x_l, labels_l,
+                                       off_l, w_l, c0_l)
+
+    return jax.shard_map(
+        local_solve, mesh=mesh,
+        in_specs=(s3, s2, s2, s2, s2), out_specs=out_specs,
+        # pallas_call's out_shapes carry no varying-mesh-axes info
+        check_vma=False,
+    )(x, labels, offsets, weights, coef0)
 
 
 def _pallas_interpret() -> bool:
@@ -657,24 +694,9 @@ def _solve_block(
         objective, config, block.x, sharded=sharded and mesh is None)
 
     if use_kernel and sharded and mesh is not None:
-        from jax.sharding import PartitionSpec as P
-
-        s2, s3 = P("data", None), P("data", None, None)
-        out_specs = OptimizerResult(
-            x=s2, value=P("data"), grad_norm=P("data"),
-            iterations=P("data"), reason=P("data"),
-            value_history=None, grad_norm_history=None, coef_history=None)
-
-        def local_solve(x, labels, off, w, c0):
-            return _dispatch_pallas_solver(objective, config, x, labels,
-                                           off, w, c0)
-
-        return jax.shard_map(
-            local_solve, mesh=mesh,
-            in_specs=(s3, s2, s2, s2, s2), out_specs=out_specs,
-            # pallas_call's out_shapes carry no varying-mesh-axes info
-            check_vma=False,
-        )(block.x, block.labels, offsets, block.weights, coefs0)
+        return _shard_mapped_pallas_solver(
+            objective, config, mesh, block.x, block.labels, offsets,
+            block.weights, coefs0)
 
     if use_kernel:
         return _dispatch_pallas_solver(objective, config, block.x,

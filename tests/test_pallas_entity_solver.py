@@ -494,3 +494,45 @@ def test_kernel_composes_with_entity_sharding(monkeypatch, rng):
     # padding entities (weight 0) converge instantly at zero
     np.testing.assert_array_equal(np.asarray(sharded.x[e:]), 0.0)
     np.testing.assert_array_equal(np.asarray(sharded.iterations[e:]), 0)
+
+
+def test_factored_kernel_composes_with_entity_sharding(monkeypatch, rng):
+    """The factored-latent kernel also composes with entity sharding via
+    shard_map (B replicated, latent designs sharded)."""
+    from photon_ml_tpu.algorithm.coordinates import _solve_factored_block
+    from photon_ml_tpu.data.random_effect import EntityBlock
+    from photon_ml_tpu.parallel import make_mesh, shard_block
+
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    e, r, d, k = 13, 4, 5, 2  # pads to 16 entities over 8 devices
+    x, y, off, w = _bucket(rng, e, r, d, dtype)
+    block = EntityBlock(
+        x=jnp.asarray(x), labels=jnp.asarray(y), offsets=jnp.asarray(off),
+        weights=jnp.asarray(w),
+        row_ids=np.zeros((e, r), np.int32),
+        feat_idx=np.broadcast_to(np.arange(d, dtype=np.int32), (e, d)))
+    B = jnp.asarray(rng.normal(0, 0.5, (k, d)).astype(dtype))
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+
+    def cfg(tol):
+        return GLMOptimizationConfiguration(
+            max_iterations=20, tolerance=tol, regularization_weight=0.3,
+            regularization_context=RegularizationContext(
+                RegularizationType.L2))
+
+    monkeypatch.setenv("PHOTON_ML_TPU_PALLAS_INTERPRET", "1")
+    plain = _solve_factored_block(obj, cfg(1e-8), block, B, None,
+                                  jnp.zeros((e, k), dtype), d)
+    assert plain.value_history is None
+
+    mesh = make_mesh()
+    sblock = shard_block(block, mesh, sentinel_row=1000)
+    ep = sblock.num_entities
+    sharded = _solve_factored_block(obj, cfg(1.001e-8), sblock, B, None,
+                                    jnp.zeros((ep, k), dtype), d,
+                                    sharded=True, mesh=mesh)
+    assert sharded.value_history is None
+    np.testing.assert_allclose(np.asarray(sharded.x[:e]),
+                               np.asarray(plain.x),
+                               atol=gold(1e-6, f32_floor=5e-3))
+    np.testing.assert_array_equal(np.asarray(sharded.x[e:]), 0.0)
