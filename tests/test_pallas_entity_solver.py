@@ -536,3 +536,20 @@ def test_factored_kernel_composes_with_entity_sharding(monkeypatch, rng):
                                np.asarray(plain.x),
                                atol=gold(1e-6, f32_floor=5e-3))
     np.testing.assert_array_equal(np.asarray(sharded.x[e:]), 0.0)
+
+
+def test_vmem_oversize_bucket_keeps_vmapped_path(monkeypatch, rng):
+    """Buckets whose kernel working set would exceed the VMEM budget
+    route to the vmapped solver even when the kernel is forced on."""
+    from photon_ml_tpu.algorithm.coordinates import _use_pallas_entity_solver
+    from photon_ml_tpu.ops.glm_objective import GLMObjective as Obj
+
+    obj = Obj(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    cfg = GLMOptimizationConfiguration(
+        max_iterations=10, tolerance=1e-6, regularization_weight=0.5,
+        regularization_context=RegularizationContext(RegularizationType.L2))
+    monkeypatch.setenv("PHOTON_ML_TPU_PALLAS_INTERPRET", "1")
+    small = jax.ShapeDtypeStruct((100, 8, 16), jnp.float32)
+    big = jax.ShapeDtypeStruct((100, 400, 128), jnp.float32)  # ~26 MB tile
+    assert _use_pallas_entity_solver(obj, cfg, small, sharded=False)
+    assert not _use_pallas_entity_solver(obj, cfg, big, sharded=False)
