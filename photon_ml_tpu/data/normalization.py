@@ -85,6 +85,145 @@ def no_normalization() -> NormalizationContext:
     return NormalizationContext(factors=None, shifts=None, intercept_id=-1)
 
 
+# ---------------------------------------------------------------------------
+# Gathered (per-entity local-space) normalization — the random-effect flavor.
+#
+# Random-effect blocks carry per-entity LOCAL feature columns (a gather of
+# the global space through feat_idx, data/random_effect.py EntityBlock); the
+# same normalization algebra applies with the factor/shift vectors gathered
+# through the same map. Reference: RandomEffectOptimizationProblem.scala:105-125
+# passes the broadcast NormalizationContext into every per-entity problem.
+# ---------------------------------------------------------------------------
+
+
+def gather_normalization(norm: NormalizationContext, feat_idx):
+    """Gather (factors, shifts, intercept_mask) into a block's local
+    feature space. feat_idx is i32[E, d_local] with -1 for padding columns;
+    padding gets factor 1 / shift 0 so all-zero padding columns stay
+    exactly zero through the x' = (x - shift) .* factor transform.
+    Returns [E, d_local] float arrays (factors/shifts None when the
+    context has none); intercept_mask is 1.0 at each entity's intercept
+    column (needed by the shift-absorbing space transforms)."""
+    safe = jnp.maximum(feat_idx, 0)
+    pad = feat_idx < 0
+
+    factors = None
+    if norm.factors is not None:
+        factors = jnp.where(pad, 1.0, norm.factors[safe])
+    shifts = None
+    if norm.shifts is not None:
+        if norm.intercept_id < 0:
+            raise ValueError(
+                "Normalization with shifts requires an intercept column")
+        # Every entity's local block must actually CONTAIN the intercept
+        # column — an all-zero intercept_mask would silently drop the
+        # shift-absorbing term from the space round-trip, producing
+        # models whose margins are off by a per-entity constant.
+        fi = np.asarray(feat_idx)
+        # Sentinel padding entities (mesh sharding pads the entity axis
+        # with all-padding rows, feat_idx == -1 everywhere) carry no data
+        # and zero coefficients — exempt.
+        present = (fi == norm.intercept_id).any(axis=-1) | (fi < 0).all(
+            axis=-1)
+        if not present.all():
+            raise ValueError(
+                "Normalization with shifts requires the intercept column "
+                f"(global id {norm.intercept_id}) in every entity's local "
+                f"feature block; {int((~present).sum())} entities lack it "
+                "— build the random-effect dataset with intercept_col set")
+        shifts = jnp.where(pad, 0.0, norm.shifts[safe])
+    mask = (feat_idx == norm.intercept_id).astype(
+        factors.dtype if factors is not None
+        else shifts.dtype if shifts is not None else jnp.float32)
+    return factors, shifts, mask
+
+
+def gathered_to_normalized_space(coef, factors, shifts, intercept_mask):
+    """model_to_normalized_space with gathered [E, d] arrays (coef [E, d],
+    original space -> solve space). Same algebra as the context method:
+    intercept absorbs the shift dot, then divide by factors."""
+    out = coef
+    if shifts is not None:
+        dot = jnp.sum(out * shifts, axis=-1, keepdims=True)
+        out = out + intercept_mask * dot
+    if factors is not None:
+        out = out / factors
+    return out
+
+
+def gathered_to_original_space(coef, factors, shifts, intercept_mask):
+    """model_to_original_space with gathered [E, d] arrays (solve space ->
+    original space): w = w' .* factor, intercept -= w . shift."""
+    out = coef * factors if factors is not None else coef
+    if shifts is not None:
+        dot = jnp.sum(out * shifts, axis=-1, keepdims=True)
+        out = out - intercept_mask * dot
+    return out
+
+
+def _check_intercept_unbounded(lower, upper, is_intercept) -> None:
+    for b in (lower, upper):
+        if b is None:
+            continue
+        vals = np.asarray(b)
+        if np.isfinite(np.where(np.asarray(is_intercept), vals, np.nan)
+                       ).any():
+            raise ValueError(
+                "box constraints on the intercept column are not supported "
+                "together with shift normalization (the intercept absorbs "
+                "the margin shift, so an original-space box on it is not a "
+                "box in the solve space)")
+
+
+def bounds_to_normalized_space(lower, upper, norm):
+    """Original-space box bounds -> solve-space bounds.
+
+    The reference keeps the optimizer's iterate in the ORIGINAL space
+    (normalization lives inside the objective) and projects it there
+    (OptimizationUtils.projectCoefficientsToHypercube, applied at
+    LBFGS.scala:77); this codebase optimizes in the NORMALIZED space, so
+    the equivalent constraint is the transformed box: for factor > 0,
+    w in [lb, ub]  <=>  w' = w/factor in [lb/factor, ub/factor]. The
+    intercept coordinate's transform also absorbs shifts from OTHER
+    coordinates, so a finite intercept bound cannot be expressed — it is
+    rejected (reference constraint maps are per feature name and never
+    constrain the intercept in practice)."""
+    if norm is None or (norm.factors is None and norm.shifts is None):
+        return lower, upper
+    if lower is None and upper is None:
+        return lower, upper
+    if norm.shifts is not None:
+        d = len(np.asarray(lower if lower is not None else upper))
+        is_int = np.arange(d) == norm.intercept_id
+        _check_intercept_unbounded(lower, upper, is_int)
+    if norm.factors is not None:
+        if not (np.asarray(norm.factors) > 0).all():
+            raise ValueError("normalization factors must be positive")
+        if lower is not None:
+            lower = jnp.asarray(lower) / norm.factors
+        if upper is not None:
+            upper = jnp.asarray(upper) / norm.factors
+    return lower, upper
+
+
+def gathered_bounds_to_normalized_space(bounds, norm_arrays):
+    """The per-entity (gathered-arrays) version of
+    bounds_to_normalized_space: bounds = (lower, upper) [E, d] in the
+    original space, norm_arrays = (factors, shifts, intercept_mask)."""
+    if bounds is None or norm_arrays is None:
+        return bounds
+    lower, upper = bounds
+    factors, shifts, mask = norm_arrays
+    if shifts is not None:
+        _check_intercept_unbounded(lower, upper, np.asarray(mask) > 0)
+    if factors is not None:
+        if not (np.asarray(factors) > 0).all():
+            raise ValueError("normalization factors must be positive")
+        lower = lower / factors
+        upper = upper / factors
+    return lower, upper
+
+
 def build_normalization_context(
     norm_type: str,
     summary,

@@ -44,14 +44,24 @@ class FixedEffectSpec:
     feature_shard_id: str
     configs: Sequence[GLMOptimizationConfiguration]
     normalization: Optional[object] = None
+    lower_bounds: Optional[object] = None
+    upper_bounds: Optional[object] = None
 
 
 @dataclasses.dataclass
 class RandomEffectSpec:
+    """``normalization`` (a NormalizationContext over the coordinate's
+    global feature space) and ``lower_bounds``/``upper_bounds`` (global
+    [d] arrays) mirror the reference's per-problem normalization +
+    constraintMap (RandomEffectOptimizationProblem.scala:105-125)."""
+
     name: str
     data_config: RandomEffectDataConfiguration
     configs: Sequence[GLMOptimizationConfiguration]
     intercept_col: Optional[int] = None
+    normalization: Optional[object] = None
+    lower_bounds: Optional[object] = None
+    upper_bounds: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -138,6 +148,8 @@ class GameEstimator:
                         feature_shard_id=s.feature_shard_id,
                         task_type=self.task_type, config=configs[s.name],
                         normalization=s.normalization, dtype=self.dtype,
+                        lower_bounds=s.lower_bounds,
+                        upper_bounds=s.upper_bounds,
                         mesh=self.mesh)
                 elif isinstance(s, FactoredRandomEffectSpec):
                     cfg = configs[s.name]
@@ -151,7 +163,9 @@ class GameEstimator:
                     coords[s.name] = RandomEffectCoordinate(
                         name=s.name, dataset=re_datasets[s.name],
                         task_type=self.task_type, config=configs[s.name],
-                        mesh=self.mesh)
+                        mesh=self.mesh, normalization=s.normalization,
+                        lower_bounds=s.lower_bounds,
+                        upper_bounds=s.upper_bounds)
             cd = CoordinateDescent(
                 coords, self.task_type,
                 validation_data=validation_data,

@@ -31,17 +31,19 @@ FIRST Armijo-passing candidate, like optimization/glm_lbfgs.py's batched
 search with its tail folded in).
 
 Routing: algorithm/coordinates.py uses this kernel for random-effect
-bucket solves on TPU — unconstrained L-BFGS with L2, OWL-QN for
-L1/elastic-net, or TRON (trust-region Newton-CG, twice-differentiable
-losses), all un-normalized; bounds, normalization and mesh-sharded
-blocks fall back to the vmapped jnp path. Set PHOTON_ML_TPU_NO_PALLAS=1
-to disable.
+bucket solves on TPU — L-BFGS with L2 (box constraints via projected
+trials), OWL-QN for L1/elastic-net, or TRON (trust-region Newton-CG,
+twice-differentiable losses). Per-entity feature normalization folds
+into all three modes as a one-time x' = (x - shift).*factor transform
+in VMEM. Remaining fallbacks to the vmapped jnp path: oversize-VMEM
+buckets, non-TPU backends, and TRON+bounds. Set
+PHOTON_ML_TPU_NO_PALLAS=1 to disable.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +63,33 @@ Array = jax.Array
 
 LANES = 128
 _CAUTIOUS_EPS = 1e-10
+
+# Kernel hyperparameter defaults — shared with the routing guard in
+# algorithm/coordinates.py via entity_solver_vmem_bytes so the VMEM
+# eligibility estimate can never drift from the kernel's actual working
+# set (the dispatch and the guard both read these constants).
+DEFAULT_M = 10
+DEFAULT_MAX_LINE_SEARCH = 30
+
+
+def entity_solver_vmem_bytes(
+    r: int, d: int, itemsize: int, *, m: int = DEFAULT_M,
+    max_line_search: int = DEFAULT_MAX_LINE_SEARCH,
+    normalized: bool = False, bounded: bool = False,
+) -> int:
+    """VMEM working-set estimate per 128-entity grid step: the
+    double-buffered x tile, 2m history buffers + c/g/direction and
+    friends, the [T, 128] line-search block, and the [r, 128] vectors.
+    Normalization adds double-buffered factor/shift tiles; bounds add
+    lower/upper tiles. Keep callers' eligibility checks on THIS function
+    so the guard and the kernel cannot disagree about the working set."""
+    units = 2 * r * d + 2 * m * d + 8 * d + 8 * r + 2 * (max_line_search + 1)
+    units += 2  # scalars / slack
+    if normalized:
+        units += 4 * d
+    if bounded:
+        units += 4 * d
+    return units * LANES * itemsize
 
 
 class _KState(NamedTuple):
@@ -120,20 +149,52 @@ def _sel(mask, a, b):
 
 def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
                  m: int, c1: float, max_line_search: int,
-                 owlqn: bool = False):
+                 owlqn: bool = False, normalized: bool = False,
+                 bounded: bool = False):
     not_conv = np.int32(int(ConvergenceReason.NOT_CONVERGED))
     shrink = 0.5
     n_trials = max_line_search + 1
+    if bounded and owlqn:
+        raise ValueError("box constraints with L1 are not supported "
+                         "(matching solve_glm)")
 
     def kernel(l2_ref, l1_ref, x_ref, y_ref, off_ref, w_ref, c0_ref,
-               out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
-               out_reason_ref):
+               *refs):
+        # Optional inputs trail the fixed seven, in declaration order:
+        # [factor, shift] when normalized, [lower, upper] when bounded.
+        i = 0
+        if normalized:
+            factor_ref, shift_ref = refs[i], refs[i + 1]
+            i += 2
+        if bounded:
+            lb_ref, ub_ref = refs[i], refs[i + 1]
+            i += 2
+        (out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
+         out_reason_ref) = refs[i:]
+
         yv = y_ref[:]  # [r, L]
         off = off_ref[:]
         w = w_ref[:]
         l2 = l2_ref[0]
         l1 = l1_ref[0]
         x_rows = [x_ref[i] for i in range(r)]  # each [d, L]
+        if normalized:
+            # Normalization folds in as a one-time transform of the x
+            # rows already resident in VMEM: x' = (x - shift) .* factor
+            # (data/normalization.py's algebra, NormalizationContext.
+            # scala:38-83). Everything downstream — margins, gradients,
+            # curvature, the line search — is the plain un-normalized
+            # kernel on x'. Solve-space coefficients; the coordinate
+            # back-transforms outside.
+            fac = factor_ref[:]  # [d, L]
+            shf = shift_ref[:]
+            x_rows = [(xr - shf) * fac for xr in x_rows]
+        if bounded:
+            lb = lb_ref[:]  # [d, L]
+            ub = ub_ref[:]
+
+            def project(c):
+                return jnp.minimum(jnp.maximum(c, lb), ub)
 
         def margins(c):
             return jnp.concatenate(
@@ -156,6 +217,8 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
             return pseudo_gradient(c, g, l1)
 
         c0 = c0_ref[:]
+        if bounded:
+            c0 = project(c0)  # host path projects x0 before evaluating
         z0 = margins(c0)
         f0 = value_from(z0, _rsum(c0 * c0))
         if owlqn:
@@ -307,6 +370,64 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
             return finish(st, active, ok, c_new, z_new, f_new, g_new,
                           gnorm_new)
 
+        def body_bounded(st: _KState) -> _KState:
+            """Projected L-BFGS iteration, exactly the host semantics
+            (optimization/lbfgs.py:173-229 + OptimizationUtils.scala:53):
+            each trial point is clamped onto [lower, upper], Armijo is
+            evaluated on the realized (projected) displacement
+            <g, x_t - x>, convergence uses the raw gradient norm, and
+            curvature pairs come from the projected accepted step.
+            Clamping breaks the affine-margin identity, so every trial
+            re-computes margins (register work, like OWL-QN's orthant
+            projection)."""
+            active = st.reason == not_conv
+            direction = _two_loop(st.g, st.s_hist, st.y_hist, st.rho,
+                                  st.count)
+            dg = _rsum(direction * st.g)
+            direction = _sel(dg >= 0, -st.g, direction)
+
+            first = st.count == 0
+            dnorm = jnp.sqrt(_rsum(direction * direction))
+            init_step = jnp.where(first,
+                                  1.0 / jnp.maximum(dnorm, 1.0), 1.0)
+
+            def trial(t):
+                x_t = project(st.c + t * direction)
+                z_t = margins(x_t)
+                f_t = value_from(z_t, _rsum(x_t * x_t))
+                armijo = jnp.logical_and(
+                    f_t <= st.f + c1 * _rsum(st.g * (x_t - st.c)),
+                    jnp.isfinite(f_t))
+                return armijo, x_t, z_t, f_t
+
+            def sweep(k_lo, k_hi, carry):
+                found, x_acc, z_acc, f_acc = carry
+                for k in range(k_lo, k_hi):
+                    t = init_step * (shrink ** k)
+                    a, x_t, z_t, f_t = trial(t)
+                    take = jnp.logical_and(a, ~found)
+                    z_t = jnp.where(jnp.isfinite(z_t), z_t, 0.0)
+                    x_acc = _sel(take, x_t, x_acc)
+                    z_acc = _sel(take, z_t, z_acc)
+                    f_acc = jnp.where(take, f_t, f_acc)
+                    found = jnp.logical_or(found, a)
+                return found, x_acc, z_acc, f_acc
+
+            t1 = min(n_trials, 8)
+            carry = (jnp.zeros_like(active), st.c, st.z, st.f)
+            carry = sweep(0, t1, carry)
+            if n_trials > t1:
+                need_tail = jnp.any(jnp.logical_and(active, ~carry[0]))
+                carry = lax.cond(need_tail,
+                                 lambda c: sweep(t1, n_trials, c),
+                                 lambda c: c, carry)
+            ok, c_new, z_new, f_new = carry
+
+            g_new = grad_from(c_new, z_new)
+            gnorm_new = jnp.sqrt(_rsum(g_new * g_new))
+            return finish(st, active, ok, c_new, z_new, f_new, g_new,
+                          gnorm_new)
+
         def body(st: _KState) -> _KState:
             active = st.reason == not_conv  # [1, L]
             direction = _two_loop(st.g, st.s_hist, st.y_hist, st.rho,
@@ -348,8 +469,14 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
                 t_acc = jnp.max(jnp.where(armijo, ts, 0.0), axis=0,
                                 keepdims=True)
                 hit = jnp.logical_and(armijo, ts == t_acc)
+                # Tie-safe: if step underflow ever makes two candidates
+                # equal, their f_t are identical too — average instead of
+                # summing so the degenerate tie cannot double-count.
+                nhit = jnp.maximum(
+                    jnp.sum(hit.astype(f_t.dtype), axis=0, keepdims=True),
+                    1.0)
                 f_acc = jnp.sum(jnp.where(hit, f_t, 0.0), axis=0,
-                                keepdims=True)
+                                keepdims=True) / nhit
                 return jnp.any(armijo, axis=0, keepdims=True), t_acc, f_acc
 
             t1 = min(n_trials, 8)
@@ -385,7 +512,9 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
             return jnp.logical_and(st.k < max_iter,
                                    jnp.any(st.reason == not_conv))
 
-        final = lax.while_loop(cond, body_owlqn if owlqn else body, state)
+        step = (body_owlqn if owlqn
+                else body_bounded if bounded else body)
+        final = lax.while_loop(cond, step, state)
 
         out_c_ref[:] = final.c
         out_f_ref[:] = final.f
@@ -399,27 +528,40 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
 
 def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
                       tol: float, max_cg: int = 20,
-                      max_improvement_failures: int = 5):
+                      max_improvement_failures: int = 5,
+                      normalized: bool = False):
     """TRON (trust-region Newton-CG) per-entity kernel — the same
     LIBLINEAR rules as optimization/tron.py (sigma/eta constants, radius
     interpolation, improvement-failure budget), vectorized over lanes
     with a nested masked CG while-loop. The Gauss-Newton product uses
     margin-cached curvature weights computed once per outer iteration:
-    Hv = X^T (d2w * (X v)) + l2 v — two r-row sweeps per CG step."""
+    Hv = X^T (d2w * (X v)) + l2 v — two r-row sweeps per CG step.
+    Normalization folds in as the same one-time x' = (x - shift).*factor
+    transform as the L-BFGS kernel (margins, gradients and Hv all see
+    x')."""
     not_conv = np.int32(int(ConvergenceReason.NOT_CONVERGED))
     ETA0, ETA1, ETA2 = 1e-4, 0.25, 0.75
     SIG1, SIG2, SIG3 = 0.25, 0.5, 4.0
     CG_XI = 0.1
 
     def kernel(l2_ref, l1_ref, x_ref, y_ref, off_ref, w_ref, c0_ref,
-               out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
-               out_reason_ref):
+               *refs):
         del l1_ref  # TRON is L2-only (solve_glm rejects L1+TRON)
+        i = 0
+        if normalized:
+            factor_ref, shift_ref = refs[i], refs[i + 1]
+            i += 2
+        (out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
+         out_reason_ref) = refs[i:]
         yv = y_ref[:]
         off = off_ref[:]
         w = w_ref[:]
         l2 = l2_ref[0]
         x_rows = [x_ref[i] for i in range(r)]
+        if normalized:
+            fac = factor_ref[:]
+            shf = shift_ref[:]
+            x_rows = [(xr - shf) * fac for xr in x_rows]
 
         def margins(c):
             return jnp.concatenate(
@@ -619,25 +761,43 @@ def pallas_entity_lbfgs(
     coef0: Array,  # [E, d]
     l2_weight,
     l1_weight=0.0,
+    factors: Optional[Array] = None,  # [E, d] normalization factors
+    shifts: Optional[Array] = None,   # [E, d] normalization shifts
+    lower: Optional[Array] = None,    # [E, d] box lower bounds
+    upper: Optional[Array] = None,    # [E, d] box upper bounds
     *,
     max_iter: int = 100,
     tol: float = 1e-7,
-    m: int = 10,
+    m: int = DEFAULT_M,
     c1: float = 1e-4,
-    max_line_search: int = 30,
+    max_line_search: int = DEFAULT_MAX_LINE_SEARCH,
     mode: str = "lbfgs",
     interpret: bool = False,
 ) -> OptimizerResult:
-    """Batched per-entity unconstrained GLM solve via the fused Pallas
-    kernel. ``mode``: "lbfgs" (L2), "owlqn" (elastic net — l1_weight
-    applies), or "tron" (trust-region Newton-CG, L2, reference defaults
-    for the CG budget). Returns an OptimizerResult with [E]-leading
+    """Batched per-entity GLM solve via the fused Pallas kernel.
+    ``mode``: "lbfgs" (L2), "owlqn" (elastic net — l1_weight applies),
+    or "tron" (trust-region Newton-CG, L2, reference defaults for the
+    CG budget).
+
+    ``factors``/``shifts`` fold per-entity feature normalization into
+    the kernel (x' = (x - shift) .* factor computed once in VMEM;
+    NormalizationContext.scala:38-83 semantics). Coefficients in and out
+    are in the SOLVE (normalized) space — callers own the space
+    transforms. ``lower``/``upper`` activate projected L-BFGS
+    ("lbfgs" mode only; matching optimization/lbfgs.py's projected
+    trial semantics). Returns an OptimizerResult with [E]-leading
     leaves (value / gradient-norm histories are not tracked on this
     path — None)."""
     e, r, d = x.shape
     dtype = x.dtype
     ep = -(-e // LANES) * LANES
     pad = ep - e
+
+    normalized = factors is not None or shifts is not None
+    bounded = lower is not None or upper is not None
+    if bounded and mode != "lbfgs":
+        raise ValueError(
+            f"box constraints are only supported in lbfgs mode, not {mode!r}")
 
     def to_lanes(a, trail):
         a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
@@ -648,13 +808,40 @@ def pallas_entity_lbfgs(
     off_l = to_lanes(offsets.astype(dtype), (r,))
     w_l = to_lanes(weights.astype(dtype), (r,))  # pad weights are 0
     c0_l = to_lanes(coef0.astype(dtype), (d,))
+    extra_inputs = []
+    if normalized:
+        fac = (jnp.ones((e, d), dtype) if factors is None
+               else factors.astype(dtype))
+        shf = (jnp.zeros((e, d), dtype) if shifts is None
+               else shifts.astype(dtype))
+        # Padding lanes: factor 1 keeps x' = x = 0 there (jnp.pad default
+        # 0 for the shift, but the factor tile must pad with 1s so no
+        # 0*inf appears if bounds are infinite).
+        extra_inputs += [
+            jnp.pad(jnp.moveaxis(fac, 0, -1), ((0, 0), (0, pad)),
+                    constant_values=1.0),
+            jnp.pad(jnp.moveaxis(shf, 0, -1), ((0, 0), (0, pad))),
+        ]
+    if bounded:
+        lo = (jnp.full((e, d), -jnp.inf, dtype) if lower is None
+              else lower.astype(dtype))
+        hi = (jnp.full((e, d), jnp.inf, dtype) if upper is None
+              else upper.astype(dtype))
+        extra_inputs += [
+            jnp.pad(jnp.moveaxis(lo, 0, -1), ((0, 0), (0, pad)),
+                    constant_values=-jnp.inf),
+            jnp.pad(jnp.moveaxis(hi, 0, -1), ((0, 0), (0, pad)),
+                    constant_values=jnp.inf),
+        ]
 
     if mode == "tron":
-        kernel = _make_tron_kernel(loss, r=r, max_iter=max_iter, tol=tol)
+        kernel = _make_tron_kernel(loss, r=r, max_iter=max_iter, tol=tol,
+                                   normalized=normalized)
     elif mode in ("lbfgs", "owlqn"):
         kernel = _make_kernel(loss, r=r, max_iter=max_iter, tol=tol, m=m,
                               c1=c1, max_line_search=max_line_search,
-                              owlqn=mode == "owlqn")
+                              owlqn=mode == "owlqn", normalized=normalized,
+                              bounded=bounded)
     else:
         raise ValueError(f"unknown mode {mode!r}: "
                          "expected lbfgs | owlqn | tron")
@@ -679,13 +866,13 @@ def pallas_entity_lbfgs(
             pl.BlockSpec(memory_space=pltpu.SMEM),  # l2 scalar
             pl.BlockSpec(memory_space=pltpu.SMEM),  # l1 scalar
             bspec(r, d), bspec(r), bspec(r), bspec(r), bspec(d),
-        ],
+        ] + [bspec(d) for _ in extra_inputs],
         out_specs=(bspec(d), bspec(1), bspec(1), bspec(1), bspec(1)),
         out_shape=out_shapes,
         interpret=interpret,
     )(jnp.asarray(l2_weight, dtype).reshape(1),
       jnp.asarray(l1_weight, dtype).reshape(1),
-      x_l, y_l, off_l, w_l, c0_l)
+      x_l, y_l, off_l, w_l, c0_l, *extra_inputs)
 
     return OptimizerResult(
         x=jnp.moveaxis(c_l, -1, 0)[:e],
