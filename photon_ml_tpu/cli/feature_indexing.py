@@ -1,7 +1,10 @@
 """Feature indexing job (reference: ml/FeatureIndexingJob.scala:59-350):
 scan training Avro, build a name⊕term -> index map per feature shard, persist.
-The reference writes partitioned PalDB stores; here a JSON map per shard is
-sufficient (SURVEY §2.9)."""
+``--format json`` (default) writes this package's JSON map per shard;
+``--format paldb`` writes partitioned PalDB 1.1 stores exactly like the
+reference (FeatureIndexingJob.scala:145-174 via PalDBIndexMapBuilder —
+both directions per partition, Spark HashPartitioner, cumulative-offset
+global indices), so downstream Photon-adjacent tooling can consume them."""
 
 from __future__ import annotations
 
@@ -17,8 +20,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="photon-feature-indexing-job")
     p.add_argument("--data-path", required=True)
     p.add_argument("--partition-num", type=int, default=1,
-                   help="accepted for reference-CLI compatibility; the JSON "
-                        "store is single-partition")
+                   help="PalDB store partition count (ignored by the "
+                        "single-partition JSON format)")
+    p.add_argument("--format", default="json", choices=["json", "paldb"],
+                   help="index store format: this package's JSON map or "
+                        "reference-compatible partitioned PalDB stores")
     p.add_argument("--add-intercept", default="true",
                    choices=["true", "false"])
     p.add_argument("--output-dir", required=True)
@@ -37,9 +43,23 @@ def run(argv=None) -> Path:
     logger = setup_photon_logger(out_dir)
     imap = build_index_map(args.data_path,
                            add_intercept=args.add_intercept == "true")
-    out = out_dir / f"{args.shard_name}.json"
-    imap.save(out)
-    logger.info("indexed %d features -> %s", len(imap), out)
+    if args.format == "paldb":
+        from photon_ml_tpu.data.paldb import build_paldb_index_stores
+
+        # Re-index through the partitioned builder: per-partition local
+        # indices + cumulative offsets, the layout PalDBIndexMap.load
+        # expects (indices change from the scan order, as they do in the
+        # reference where the partitioned store IS the index authority).
+        names = [k for k, _ in sorted(imap.key_items(), key=lambda kv: kv[1])]
+        imap = build_paldb_index_stores(out_dir, args.shard_name, names,
+                                        num_partitions=args.partition_num)
+        out = out_dir / f"paldb-partition-{args.shard_name}-0.dat"
+        logger.info("indexed %d features -> %s (%d PalDB partitions)",
+                    len(imap), out_dir, args.partition_num)
+    else:
+        out = out_dir / f"{args.shard_name}.json"
+        imap.save(out)
+        logger.info("indexed %d features -> %s", len(imap), out)
     if args.save_name_and_term_sets == "true":
         from photon_ml_tpu.data.index_map import INTERCEPT_KEY, split_key
         from photon_ml_tpu.data.name_and_term import (

@@ -229,6 +229,29 @@ def test_feature_indexing_job(tmp_path, rng):
     assert len(imap) == 6  # f0..f4 + intercept
 
 
+def test_feature_indexing_job_paldb_format(tmp_path, rng):
+    """--format paldb writes reference-layout partitioned stores that the
+    PalDB parser (and therefore any --feature-index-dir consumer) loads
+    back identically (FeatureIndexingJob.scala:145-174)."""
+    train = tmp_path / "train"
+    _write_glm_avro(train, rng, n=50)
+    out_dir = tmp_path / "paldb-index"
+    feature_indexing.run([
+        "--data-path", str(train),
+        "--output-dir", str(out_dir),
+        "--format", "paldb",
+        "--partition-num", "2",
+        "--shard-name", "global",
+    ])
+    from photon_ml_tpu.data.paldb import load_paldb_index_map
+
+    assert (out_dir / "paldb-partition-global-0.dat").exists()
+    assert (out_dir / "paldb-partition-global-1.dat").exists()
+    imap = load_paldb_index_map(out_dir, "global", 2)
+    assert len(imap) == 6
+    assert imap.intercept_index >= 0
+
+
 def test_game_driver_rejects_unknown_sequence_entry(tmp_path, rng):
     train = tmp_path / "train"
     _write_game_avro(train, rng, n=20)

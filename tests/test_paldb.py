@@ -147,7 +147,6 @@ def test_load_feature_index_maps_both_formats(tmp_path):
 def test_training_driver_accepts_feature_index_dir(tmp_path):
     """--feature-index-dir pointing at reference PalDB stores drives a real
     (tiny) GAME training run with the preloaded index space."""
-    import scipy.sparse as sp
 
     from photon_ml_tpu.cli.game_training_driver import run as train_run
     from photon_ml_tpu.data.paldb import load_paldb_index_map
@@ -232,3 +231,149 @@ def test_glm_driver_accepts_offheap_indexmap_dir(tmp_path):
     # index space (intercept included).
     model_txt = (tmp_path / "out" / "best-model" / "model.txt").read_text()
     assert "(INTERCEPT)" in model_txt
+
+
+# ---------------------------------------------------------------------------
+# Writer (VERDICT r3 missing #1): write -> read round trip + layout parity
+# with the reference's own fixture structure.
+# ---------------------------------------------------------------------------
+
+
+def test_write_store_round_trips(tmp_path):
+    from photon_ml_tpu.data.paldb import write_paldb_store
+
+    pairs = [("a\x01t", 0), (0, "a\x01t"), ("b\x01", 1), (1, "b\x01"),
+             ("long-feature-name\x01with-term", 300),
+             (300, "long-feature-name\x01with-term"),
+             ("i9", 9), (9, "i9"), ("i255", 255), (255, "i255"),
+             ("unicode-é中", 70000), (70000, "unicode-é中")]
+    path = tmp_path / "paldb-partition-t-0.dat"
+    write_paldb_store(path, pairs)
+    got = dict(read_paldb_store(path))
+    assert got == dict(pairs)
+
+
+def test_write_store_multibyte_offsets(tmp_path):
+    """Enough entries in one key-length class that data offsets need
+    multi-byte varints (the slot size grows accordingly)."""
+    from photon_ml_tpu.data.paldb import write_paldb_store
+
+    pairs = [(f"f{i:04d}\x01term-{i:04d}", i) for i in range(2000)]
+    path = tmp_path / "big.dat"
+    write_paldb_store(path, pairs)
+    got = dict(read_paldb_store(path))
+    assert len(got) == 2000
+    assert got["f1999\x01term-1999"] == 1999
+
+
+def test_write_store_rejects_duplicates_allows_empty(tmp_path):
+    from photon_ml_tpu.data.paldb import write_paldb_store
+
+    with pytest.raises(ValueError, match="duplicate"):
+        write_paldb_store(tmp_path / "d.dat", [("a", 1), ("a", 2)])
+    # An empty store is legal — hash partitions can be empty and the
+    # 0..N-1 filename scan still needs the file to exist.
+    write_paldb_store(tmp_path / "e.dat", [])
+    assert list(read_paldb_store(tmp_path / "e.dat")) == []
+
+
+@pytest.mark.parametrize("num_partitions", [1, 3])
+def test_build_index_stores_round_trip(tmp_path, num_partitions):
+    from photon_ml_tpu.data.paldb import build_paldb_index_stores
+
+    names = [feature_key(f"name{i}", f"t{i % 4}") for i in range(50)]
+    names.append(INTERCEPT_KEY)
+    written = build_paldb_index_stores(tmp_path, "myShard", names,
+                                       num_partitions=num_partitions)
+    loaded = load_paldb_index_map(tmp_path, "myShard", num_partitions)
+    assert dict(written.key_items()) == dict(loaded.key_items())
+    assert sorted(i for _, i in loaded.key_items()) == list(range(len(names)))
+
+
+def test_written_store_layout_matches_fixture_structure(tmp_path):
+    """Re-write the reference fixture's CONTENT with our writer and
+    compare the container structure field by field: same sections (key
+    lengths, counts), same slot counts (Math.round(count/0.75)), same
+    slot sizes, same empty-slot/data-sentinel conventions. Byte identity
+    is not expected (insertion order differs), but every structural
+    header field the PalDB 1.1 reader navigates by must match."""
+    import struct as st
+
+    from photon_ml_tpu.data.paldb import write_paldb_store
+
+    fixture = (Path("/root/reference/photon-ml/src/test/resources/"
+                    "PalDBIndexMapTest/paldb_offheapmap_for_heart") /
+               "paldb-partition-global-0.dat")
+
+    def header_fields(path):
+        raw = Path(path).read_bytes()
+        n_magic = st.unpack_from(">H", raw, 0)[0]
+        o = 2 + n_magic + 8
+        key_count, klc, mkl = st.unpack_from(">iii", raw, o)
+        o += 12
+        secs = []
+        for _ in range(klc):
+            klen, kcnt, slots, ssize, _io = st.unpack_from(">iiiii", raw, o)
+            o += 28
+            secs.append((klen, kcnt, slots, ssize))
+        return key_count, mkl, secs
+
+    pairs = list(read_paldb_store(fixture))
+    ours = tmp_path / "rewrite.dat"
+    write_paldb_store(ours, pairs)
+
+    ref_kc, ref_mkl, ref_secs = header_fields(fixture)
+    our_kc, our_mkl, our_secs = header_fields(ours)
+    assert our_kc == ref_kc
+    assert our_mkl == ref_mkl
+    assert our_secs == ref_secs
+    # And the rewrite round-trips to identical content.
+    assert dict(read_paldb_store(ours)) == dict(pairs)
+
+
+def test_slot_hash_matches_fixture_placement():
+    """The writer's murmur3(seed 42) slot hash reproduces the placement
+    observed in the reference's own stores: every key sits at its hash
+    slot or within linear-probe distance of it."""
+    import struct as st
+
+    from photon_ml_tpu.data.paldb import (
+        _MAGIC,
+        _murmur3_32,
+        _unpack_varint,
+    )
+
+    fixture = GAME_INPUT / "feature-indexes" / "paldb-partition-shard1-0.dat"
+    raw = fixture.read_bytes()
+    n_magic = st.unpack_from(">H", raw, 0)[0]
+    assert raw[2:2 + n_magic].decode() == _MAGIC
+    o = 2 + n_magic + 8
+    key_count, klc, _ = st.unpack_from(">iii", raw, o)
+    o += 12
+    secs = []
+    for _ in range(klc):
+        klen, kcnt, slots, ssize, ioff = st.unpack_from(">iiiii", raw, o)
+        o += 28
+        secs.append((klen, kcnt, slots, ssize, ioff))
+    o += 4
+    index_start = st.unpack_from(">i", raw, o)[0]
+
+    exact = probed = 0
+    for klen, kcnt, slots, ssize, ioff in secs:
+        base = index_start + ioff
+        occupancy = kcnt / slots
+        for s in range(slots):
+            slot = raw[base + s * ssize: base + (s + 1) * ssize]
+            if _unpack_varint(slot, klen)[0] == 0:
+                continue
+            h = _murmur3_32(bytes(slot[:klen])) % slots
+            dist = (s - h) % slots
+            if dist == 0:
+                exact += 1
+            else:
+                probed += 1
+                assert dist <= kcnt, "key unreachable by linear probing"
+    assert exact + probed == key_count
+    # The hash must explain the bulk of placements directly (collisions
+    # at 0.75 load factor account for the rest).
+    assert exact / key_count > 0.5
