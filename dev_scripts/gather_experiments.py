@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""Sparse gather-wall experiments (VERDICT r4 item 3).
+
+The d=2M sparse fixed-effect iteration is gather-bound: XLA random
+access runs at a FLAT ~148M lookups/s on v5e (docs/SCALE.md), ~0.07% of
+HBM bandwidth, making the sparse path ~440x slower per iteration than
+the dense one. Before accepting that wall, this script measures every
+alternative implementation of the core primitive
+
+    out[i] = w[idx[i]]   (w: f32[d] table, idx: i32[m], m ~ 12M, d ~ 2M)
+
+on the current backend and prints one JSON line per candidate:
+
+  xla_gather          baseline w[idx] (the 148M/s wall)
+  xla_onehot_scan     indices pre-grouped into 2048-wide column blocks;
+                      per block, a fused iota-compare one-hot (bf16)
+                      contracted against the block's w slice on the MXU.
+                      Arithmetic bound: 197e12 MAC/s / 2048 ≈ 48G
+                      lookups/s IF XLA fuses the one-hot into the dot
+                      without materializing it in HBM.
+  pallas_onehot       the same contraction written explicitly as a
+                      Pallas kernel (one-hot built in VREGs, jnp.dot on
+                      the MXU, f32 accumulation).
+  pallas_vmem_gather  Pallas kernel holding the whole table in VMEM
+                      (8 MB at d=2M) and issuing table[idx] per tile —
+                      tests whether Mosaic's dynamic_gather beats XLA's
+                      HBM gather path.
+
+Run on a real chip:  python dev_scripts/gather_experiments.py
+CPU correctness check (tiny shapes + interpret mode):
+                     python dev_scripts/gather_experiments.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+BLOCK = 2048
+
+
+def _prep_blocks(idx: np.ndarray, d: int):
+    """Group indices by 2048-wide column block, padded per block to the
+    max per-block count (value 0 -> gathers w[block_start], masked by
+    weight 0). Returns (block_local i32[kb, e], mask f32[kb, e],
+    perm i32[m] mapping packed order back to original order)."""
+    kb = -(-d // BLOCK)
+    owner = idx // BLOCK
+    order = np.argsort(owner, kind="stable")
+    counts = np.bincount(owner, minlength=kb)
+    e = max(1, int(counts.max()))
+    local = np.zeros((kb, e), np.int32)
+    mask = np.zeros((kb, e), np.float32)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(idx)) - np.repeat(starts, counts)
+    local[owner[order], pos] = (idx[order] - owner[order] * BLOCK)
+    mask[owner[order], pos] = 1.0
+    packed_of = (owner[order] * e + pos)  # position in [kb*e] layout
+    slot = np.empty(len(idx), np.int64)
+    slot[order] = packed_of
+    return local, mask, slot
+
+
+def make_xla_gather(w, idx):
+    import jax
+
+    @jax.jit
+    def f(w, idx):
+        return w[idx]
+
+    return lambda: f(w, idx)
+
+
+def make_xla_onehot_scan(w, local, mask):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    kb, e = local.shape
+    d_pad = kb * BLOCK
+
+    @jax.jit
+    def f(w, local, mask):
+        wb = jnp.pad(w, (0, d_pad - w.shape[0])).reshape(kb, BLOCK)
+
+        def step(_, args):
+            loc, msk, wslice = args
+            onehot = (loc[:, None] ==
+                      jnp.arange(BLOCK, dtype=jnp.int32)[None, :]
+                      ).astype(jnp.bfloat16)
+            out = jnp.dot(onehot, wslice.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+            return None, out * msk
+
+        _, outs = lax.scan(step, None, (local, mask, wb))
+        return outs.reshape(-1)  # packed [kb * e]
+
+    return lambda: f(w, local, mask)
+
+
+def make_pallas_onehot(w, local, mask, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kb, e = local.shape
+    d_pad = kb * BLOCK
+    w_pad = jnp.pad(w, (0, d_pad - w.shape[0])).reshape(kb, BLOCK)
+    # e must tile to the MXU's 128-row granularity
+    ep = -(-e // 128) * 128
+    local_p = jnp.pad(local, ((0, 0), (0, ep - e)))
+    mask_p = jnp.pad(mask, ((0, 0), (0, ep - e)))
+
+    def kernel(loc_ref, msk_ref, w_ref, out_ref):
+        loc = loc_ref[:].reshape(ep, 1)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (ep, BLOCK), 1)
+        onehot = (loc == iota).astype(jnp.bfloat16)
+        wv = w_ref[:].reshape(BLOCK, 1).astype(jnp.bfloat16)
+        out = jnp.dot(onehot, wv, preferred_element_type=jnp.float32)
+        out_ref[:] = out.reshape(1, ep) * msk_ref[:]
+
+    f = pl.pallas_call(
+        kernel,
+        grid=(kb,),
+        in_specs=[
+            pl.BlockSpec((1, ep), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ep), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, ep), lambda b: (b, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((kb, ep), jnp.float32),
+        interpret=interpret,
+    )
+    jf = jax.jit(lambda l, m, wp: f(l, m, wp)[:, :e].reshape(-1))
+    return lambda: jf(local_p, mask_p, w_pad)
+
+
+def make_pallas_vmem_gather(w, idx, interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = idx.shape[0]
+    tile = 8 * 128
+    mp = -(-m // tile) * tile
+    idx_p = jnp.pad(idx, (0, mp - m)).reshape(mp // tile, 8, 128)
+
+    def kernel(w_ref, idx_ref, out_ref):
+        out_ref[0] = w_ref[:][idx_ref[0]]
+
+    f = pl.pallas_call(
+        kernel,
+        grid=(mp // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # whole table
+            pl.BlockSpec((1, 8, 128), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda t: (t, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((mp // tile, 8, 128), w.dtype),
+        interpret=interpret,
+    )
+    jf = jax.jit(lambda w, i: f(w, i).reshape(-1)[:m])
+    return lambda: jf(w, idx_p)
+
+
+def _time(fn, reps=5):
+    import jax
+
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def run(m, d, check=False):
+    import os
+
+    import jax
+
+    # Make JAX_PLATFORMS authoritative (a sitecustomize may force the
+    # remote-TPU plugin and hang a CPU-intended run on tunnel init —
+    # same guard as cli/__init__.py / bench.py).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    interpret = check and jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    idx_np = rng.integers(0, d, m).astype(np.int32)
+    w_np = rng.normal(0, 1, d).astype(np.float32)
+    w = jnp.asarray(w_np)
+    idx = jnp.asarray(idx_np)
+    local, mask, slot = _prep_blocks(idx_np, d)
+    local_j, mask_j = jnp.asarray(local), jnp.asarray(mask)
+    expect = w_np[idx_np]
+
+    def verify(packed_fn, packed=True):
+        out = np.asarray(packed_fn())
+        got = out[slot] if packed else out
+        np.testing.assert_allclose(got, expect, atol=2e-2)
+        return True
+
+    candidates = {
+        "xla_gather": (make_xla_gather(w, idx), False),
+        "xla_onehot_scan": (make_xla_onehot_scan(w, local_j, mask_j), True),
+        "pallas_onehot": (make_pallas_onehot(w, local_j, mask_j,
+                                             interpret=interpret), True),
+        "pallas_vmem_gather": (make_pallas_vmem_gather(w, idx,
+                                                       interpret=interpret),
+                               False),
+    }
+    results = {}
+    for name, (fn, packed) in candidates.items():
+        try:
+            verify(fn, packed)
+            dt = _time(fn) if not check else float("nan")
+            results[name] = {"ok": True,
+                             "mlookups_per_sec": (round(m / dt / 1e6, 1)
+                                                  if dt == dt else None)}
+        except Exception as e:  # noqa: BLE001 — report per-candidate
+            results[name] = {"ok": False,
+                             "error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps({"candidate": name, "m": m, "d": d,
+                          **results[name]}), flush=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="small-shape correctness check (CPU/interpret)")
+    ap.add_argument("--m", type=int, default=None)
+    ap.add_argument("--d", type=int, default=None)
+    args = ap.parse_args()
+    if args.check:
+        run(args.m or 3_000, args.d or 4_096, check=True)
+    else:
+        run(args.m or 12_000_000, args.d or 2_000_000)
+
+
+if __name__ == "__main__":
+    main()
