@@ -6,11 +6,11 @@ on real TPU, with a timed bucket solve each. Run after any kernel change
 def main():
     import time
     import numpy as np, jax, jax.numpy as jnp
-    
+
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.ops.pallas_entity_solver import pallas_entity_lbfgs
     from photon_ml_tpu.types import TaskType
-    
+
     rng = np.random.default_rng(3)
     e, r, d = 5000, 40, 25
     x = rng.normal(0, 1, (e, r, d)).astype(np.float32); x[:, :, 0] = 1.0
@@ -19,22 +19,22 @@ def main():
     y = (rng.random((e, r)) < 1/(1+np.exp(-z))).astype(np.float32)
     yp = rng.poisson(2.0, (e, r)).astype(np.float32)
     off = np.zeros((e, r), np.float32); w = np.ones((e, r), np.float32)
-    
+
     def sync(v): np.asarray(jax.device_get(jax.tree.leaves(v)[0].ravel()[0]))
-    
+
     def timed(fn, reps=8):
         out = fn(); sync(out)
         t0 = time.perf_counter()
         for _ in range(reps): out = fn()
         sync(out)
         return (time.perf_counter() - t0) / reps * 1e3, out
-    
+
     log_loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
     poi_loss = loss_for_task(TaskType.POISSON_REGRESSION)
     xa, ya, ypa = jnp.asarray(x), jnp.asarray(y), jnp.asarray(yp)
     offa, wa = jnp.asarray(off), jnp.asarray(w)
     c0 = jnp.zeros((e, d), np.float32)
-    
+
     for mode, loss, yy, l1, l2 in [
         ("lbfgs", log_loss, ya, 0.0, 1.0),
         ("owlqn", log_loss, ya, 0.5, 0.5),

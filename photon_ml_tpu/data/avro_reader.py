@@ -15,11 +15,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from photon_ml_tpu.data.game_data import GameDataset
-from photon_ml_tpu.data.index_map import (
-    INTERCEPT_KEY,
-    IndexMap,
-    feature_key,
-)
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
 from photon_ml_tpu.io.avro_codec import read_container
 
 

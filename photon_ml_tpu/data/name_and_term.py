@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 from pathlib import Path
-from typing import Dict, Iterable, Sequence, Set, Tuple
+from typing import Dict, Sequence, Set, Tuple
 
 from photon_ml_tpu.data.avro_reader import iter_records
 from photon_ml_tpu.data.index_map import (

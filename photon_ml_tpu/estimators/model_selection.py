@@ -3,7 +3,7 @@ classifiers -> max AUC; linear regression -> min RMSE; Poisson -> min loss."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 

@@ -10,11 +10,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
-import numpy as np
-import scipy.sparse as sp
 
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.models.coefficients import Coefficients

@@ -23,7 +23,7 @@ import os
 import struct
 import sys
 import zlib
-from typing import Any, BinaryIO, Dict, Iterable, Iterator, List, Optional
+from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 MAGIC = b"Obj\x01"
 DEFAULT_SYNC_INTERVAL = 16 * 1024

@@ -19,7 +19,6 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
-from photon_ml_tpu.models.glm import GeneralizedLinearModel
 
 Array = jax.Array
 

@@ -65,6 +65,16 @@ class ModelTracker:
         task: TaskType,
         normalization: Optional[NormalizationContext] = None,
     ) -> "ModelTracker":
+        if result.value_history is None or result.grad_norm_history is None:
+            # Pallas-kernel results (random-effect paths) do not track
+            # per-iteration histories — there is nothing to build states
+            # from. Surface that explicitly instead of a numpy IndexError.
+            raise ValueError(
+                "ModelTracker.from_result needs per-iteration histories; "
+                "this OptimizerResult carries none (Pallas entity-kernel "
+                "solves do not record them — use the vmapped path via "
+                "PHOTON_ML_TPU_NO_PALLAS=1 if per-iteration tracking is "
+                "required)")
         iters = int(result.iterations)
         values = np.asarray(result.value_history)[: iters + 1]
         gnorms = np.asarray(result.grad_norm_history)[: iters + 1]

@@ -10,7 +10,7 @@ reference, where L1 lives in Breeze's OWLQN and L2 in the objective mixins).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

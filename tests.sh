@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Check gate: lint + full test suite — the analog of the reference's
+# `tests.sh` / gradle `check` (scalastyle + RAT + tests,
+# /root/reference/build.gradle:48+). One command, green in a fresh clone:
+#     ./tests.sh [pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== lint =="
+python dev_scripts/lint.py
+
+echo "== tests =="
+python -m pytest tests/ -q "$@"

@@ -7,7 +7,6 @@ import pytest
 import scipy.sparse as sp
 
 from photon_ml_tpu.data.avro_reader import (
-    build_index_map,
     read_game_dataset,
     read_labeled_points,
 )

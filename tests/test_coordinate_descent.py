@@ -4,7 +4,6 @@ the analog of the reference's CoordinateDescentTest + GameEstimatorTest
 """
 
 import numpy as np
-import jax.numpy as jnp
 import scipy.sparse as sp
 
 from photon_ml_tpu.algorithm import (

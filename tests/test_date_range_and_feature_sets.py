@@ -3,7 +3,6 @@ expansion) and NameAndTermFeatureSetContainer parity tests."""
 
 import datetime
 
-import numpy as np
 import pytest
 
 from photon_ml_tpu.data.index_map import feature_key

@@ -6,7 +6,6 @@ MatrixFactorizationModel.scala:50-52."""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 from photon_ml_tpu.data.game_data import GameDataset

@@ -20,7 +20,7 @@ from photon_ml_tpu.data.random_effect import (
 )
 from photon_ml_tpu.evaluation.evaluators import SquaredLossEvaluator
 from photon_ml_tpu.models import FactoredRandomEffectModel
-from photon_ml_tpu.ops.features import DenseFeatures, KroneckerFeatures
+from photon_ml_tpu.ops.features import KroneckerFeatures
 from photon_ml_tpu.optimization.config import (
     GLMOptimizationConfiguration,
     MFOptimizationConfiguration,

@@ -5,7 +5,6 @@ import pytest
 
 from photon_ml_tpu.data.index_map import (
     DELIMITER,
-    INTERCEPT_KEY,
     IdentityIndexMap,
     IndexMap,
     feature_key,

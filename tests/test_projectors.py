@@ -15,7 +15,6 @@ from photon_ml_tpu.data.random_effect import (
     RandomEffectDataConfiguration,
     build_random_effect_dataset,
 )
-from photon_ml_tpu.models.random_effect import RandomEffectModel
 from photon_ml_tpu.optimization.config import (
     GLMOptimizationConfiguration,
     RegularizationContext,

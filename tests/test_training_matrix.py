@@ -4,8 +4,6 @@ reference's DriverTest per-optimizer/per-regularization matrices
 trains to a finite, genuinely-fit model; invalid combos raise."""
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 import pytest
 
 from photon_ml_tpu.estimators.model_training import train_glm_models
