@@ -35,20 +35,52 @@ def main():
     offa, wa = jnp.asarray(off), jnp.asarray(w)
     c0 = jnp.zeros((e, d), np.float32)
 
-    for mode, loss, yy, l1, l2 in [
-        ("lbfgs", log_loss, ya, 0.0, 1.0),
-        ("owlqn", log_loss, ya, 0.5, 0.5),
-        ("tron", poi_loss, ypa, 0.0, 1.0),
+    # Per-entity normalization arrays (STANDARDIZATION-like) and box
+    # bounds — the round-4 kernel folds; each variant must COMPILE on
+    # real Mosaic (interpret-mode parity does not prove that).
+    fac = np.tile(1.0 / np.maximum(x.std(axis=(0, 1)), 0.2), (e, 1))
+    fac[:, 0] = 1.0
+    shf = np.tile(x.mean(axis=(0, 1)), (e, 1))
+    shf[:, 0] = 0.0
+    faca = jnp.asarray(fac, np.float32)
+    shfa = jnp.asarray(shf, np.float32)
+    lba = jnp.full((e, d), -0.3, np.float32)
+    uba = jnp.full((e, d), 0.3, np.float32)
+
+    for name, mode, loss, yy, l1, l2, kw in [
+        ("lbfgs", "lbfgs", log_loss, ya, 0.0, 1.0, {}),
+        ("owlqn", "owlqn", log_loss, ya, 0.5, 0.5, {}),
+        ("tron", "tron", poi_loss, ypa, 0.0, 1.0, {}),
+        ("lbfgs+norm", "lbfgs", log_loss, ya, 0.0, 1.0,
+         dict(factors=faca, shifts=shfa)),
+        ("lbfgs+bounds", "lbfgs", log_loss, ya, 0.0, 1.0,
+         dict(lower=lba, upper=uba)),
+        ("lbfgs+norm+bounds", "lbfgs", log_loss, ya, 0.0, 1.0,
+         dict(factors=faca, shifts=shfa, lower=lba, upper=uba)),
+        ("owlqn+norm", "owlqn", log_loss, ya, 0.5, 0.5,
+         dict(factors=faca, shifts=shfa)),
+        ("tron+norm", "tron", poi_loss, ypa, 0.0, 1.0,
+         dict(factors=faca, shifts=shfa)),
     ]:
         ms, res = timed(lambda: pallas_entity_lbfgs(
             loss, xa, yy, offa, wa, c0, l2, l1,
-            max_iter=15, tol=1e-6, mode=mode))
+            max_iter=15, tol=1e-6, mode=mode, **kw))
         xs = np.asarray(jax.device_get(res.x))
-        assert np.isfinite(xs).all(), mode
-        print(f"{mode:6s}: {ms:7.2f} ms  mean_iters="
+        assert np.isfinite(xs).all(), name
+        print(f"{name:18s}: {ms:7.2f} ms  mean_iters="
               f"{float(np.asarray(res.iterations).mean()):.1f}  finite OK",
               flush=True)
-    print("ALL MODES COMPILE+RUN ON CHIP", flush=True)
+    print("ALL KERNEL VARIANTS COMPILE+RUN ON CHIP", flush=True)
+
+    # Sparse gather candidates (docs/SCALE.md wall): measured rates.
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    subprocess.run(
+        [sys.executable,
+         str(Path(__file__).with_name("gather_experiments.py"))],
+        check=False)
 
 
 if __name__ == "__main__":
