@@ -89,3 +89,66 @@ def test_random_projector_builds_latent_blocks(rng):
     assert ds.projection is not None
     assert ds.projection.projected_space_dimension == 2
     assert ds.projection.original_space_dimension == 2
+
+
+def test_random_effect_spec_normalization_through_estimator(rng):
+    """GameEstimator grid training with a normalized + bounded random
+    effect (RandomEffectSpec.normalization / bounds — the reference's
+    RandomEffectOptimizationProblem normalization + constraintMap,
+    RandomEffectOptimizationProblem.scala:105-125): the unregularized
+    factor-normalized solve matches the plain solve (parametrization
+    invariance), and bounds clamp original-space coefficients."""
+    from photon_ml_tpu.data.random_effect import (
+        RandomEffectDataConfiguration,
+    )
+    from photon_ml_tpu.estimators.game_estimator import (
+        GameEstimator,
+        RandomEffectSpec,
+    )
+
+    n, d = 240, 4
+    x = rng.normal(0, 1.0, (n, d))
+    x *= np.array([1.0, 5.0, 0.4, 2.0])[None, :]
+    x[:, 0] = 1.0
+    w = np.array([0.2, 0.3, -1.5, 0.6])
+    y = (rng.random(n) < 1 / (1 + np.exp(-x @ (w / np.array(
+        [1.0, 5.0, 0.4, 2.0]))))).astype(float)
+    data = GameDataset.build(
+        responses=y,
+        feature_shards={"s": sp.csr_matrix(x)},
+        ids={"userId": np.asarray([f"u{i % 6}" for i in range(n)])})
+    cfg = GLMOptimizationConfiguration(max_iterations=150, tolerance=1e-10)
+    norm = build_normalization_context(
+        "SCALE_WITH_STANDARD_DEVIATION",
+        BasicStatisticalSummary.compute(data.feature_shards["s"]),
+        intercept_id=0)
+
+    def fit(normalization, lb=None, ub=None):
+        est = GameEstimator(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinate_specs=[RandomEffectSpec(
+                name="re",
+                data_config=RandomEffectDataConfiguration(
+                    random_effect_type="userId", feature_shard_id="s"),
+                configs=[cfg], intercept_col=0,
+                normalization=normalization,
+                lower_bounds=lb, upper_bounds=ub)],
+            dtype=jnp.float64)
+        results = est.fit(data, seed=0)
+        assert len(results) == 1
+        model = results[0][1].model.get_model("re")
+        return np.concatenate(
+            [np.asarray(c) for c in model.local_coefs], axis=0)
+
+    coefs_norm = fit(norm)
+    coefs_plain = fit(None)
+    # Unregularized optimum is parametrization-invariant (models are
+    # stored in the original space either way).
+    np.testing.assert_allclose(coefs_norm, coefs_plain, atol=2e-3)
+
+    cap = 0.4
+    coefs_box = fit(norm, lb=np.full(d, -cap), ub=np.full(d, cap))
+    active = np.abs(coefs_plain) > cap + 0.05
+    assert active.any(), "test problem never activates the box"
+    assert (coefs_box <= cap + 1e-6).all()
+    assert (coefs_box >= -cap - 1e-6).all()
