@@ -98,6 +98,13 @@ def train_glm_models(
 
     lb = None if lower_bounds is None else jnp.asarray(lower_bounds, dtype)
     ub = None if upper_bounds is None else jnp.asarray(upper_bounds, dtype)
+    # Box constraints are ORIGINAL-space per-feature bounds (the
+    # reference projects the original-space iterate,
+    # OptimizationUtils.scala:53 applied at LBFGS.scala:77); this solve
+    # runs in the normalized space, so transform the box exactly.
+    from photon_ml_tpu.data.normalization import bounds_to_normalized_space
+
+    lb, ub = bounds_to_normalized_space(lb, ub, normalization)
 
     order = sorted(regularization_weights, reverse=True)
     coef = jnp.zeros((d,), dtype)

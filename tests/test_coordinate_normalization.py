@@ -152,3 +152,36 @@ def test_random_effect_spec_normalization_through_estimator(rng):
     assert active.any(), "test problem never activates the box"
     assert (coefs_box <= cap + 1e-6).all()
     assert (coefs_box >= -cap - 1e-6).all()
+
+
+def test_train_glm_bounds_apply_in_original_space(rng):
+    """train_glm_models with normalization + box constraints: the box
+    constrains ORIGINAL-space coefficients (reference:
+    OptimizationUtils.projectCoefficientsToHypercube on the original-
+    space iterate) — with factor normalization the strong coefficient
+    clamps at the raw cap, not cap*factor."""
+    from photon_ml_tpu.estimators.model_training import train_glm_models
+
+    n, d = 400, 4
+    x = rng.normal(0, 1.0, (n, d))
+    x[:, 0] = 1.0
+    x[:, 1] *= 10.0  # big scale -> factor 0.1
+    w_orig = np.array([0.1, 0.25, -1.4, 0.8])  # col 1 orig coef ~0.25
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_orig)))).astype(float)
+    norm = build_normalization_context(
+        "SCALE_WITH_STANDARD_DEVIATION",
+        BasicStatisticalSummary.compute(sp.csr_matrix(x)),
+        intercept_id=0)
+    cap = 0.6
+    trained = train_glm_models(
+        sp.csr_matrix(x), y, TaskType.LOGISTIC_REGRESSION,
+        regularization_weights=[0.01],
+        normalization=norm,
+        lower_bounds=np.full(d, -cap), upper_bounds=np.full(d, cap),
+        max_iterations=150, tolerance=1e-10)
+    coefs = np.asarray(trained[0].model.coefficients.means)
+    assert (np.abs(coefs) <= cap + 1e-6).all(), coefs
+    # The strong negative coefficient (|w|~1.4 unconstrained) clamps at
+    # the RAW cap; solve-space application would leave it at a different
+    # magnitude entirely.
+    assert np.isclose(np.abs(coefs).max(), cap, atol=1e-3), coefs
