@@ -21,10 +21,13 @@ on the current backend and prints one JSON line per candidate:
   pallas_onehot       the same contraction written explicitly as a
                       Pallas kernel (one-hot built in VREGs, jnp.dot on
                       the MXU, f32 accumulation).
-  pallas_vmem_gather  Pallas kernel holding the whole table in VMEM
-                      (8 MB at d=2M) and issuing table[idx] per tile —
-                      tests whether Mosaic's dynamic_gather beats XLA's
-                      HBM gather path.
+  pallas_residue_gather  Pallas kernel holding the whole table in VMEM
+                      as [d/128, 128] and issuing LANE-LOCAL
+                      dynamic_gathers over residue-class-packed indices
+                      (lane l gathers only elements with j%128 == l) —
+                      the only arbitrary-gather formulation Mosaic's
+                      gather lowering supports; a flat table[idx]
+                      raises 'Only 2D gather is supported'.
 
 Run on a real chip:  python dev_scripts/gather_experiments.py
 CPU correctness check (tiny shapes + interpret mode):
@@ -143,35 +146,71 @@ def make_pallas_onehot(w, local, mask, interpret=False):
     return lambda: jf(local_p, mask_p, w_pad)
 
 
-def make_pallas_vmem_gather(w, idx, interpret=False):
+def _prep_residue(idx: np.ndarray, d: int):
+    """Residue-class packing for Mosaic's lane-local dynamic_gather:
+    the table reshapes to T[d/128, 128] (element j at sublane j//128,
+    lane j%128) and tpu.dynamic_gather(T, C, [0]) lets lane l gather
+    only from ITS OWN column T[:, l] — i.e. elements with j%128 == l.
+    So indices are bucketed by residue j%128 (one stream per lane),
+    each stream padded to a multiple of the table's sublane count A,
+    giving C chunks of exactly the table's [A, 128] shape (the lowering
+    requires x.shape == idx.shape). Returns (sub i32[chunks, A, 128],
+    slot i64[m] mapping each original index to its packed position)."""
+    assert d % 128 == 0
+    a = d // 128
+    lane = idx % 128
+    sub = idx // 128
+    order = np.argsort(lane, kind="stable")
+    counts = np.bincount(lane, minlength=128)
+    per_lane = -(-max(1, int(counts.max())) // a) * a  # pad to A-multiple
+    chunks = per_lane // a
+    packed = np.zeros((128, per_lane), np.int32)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(len(idx)) - np.repeat(starts, counts)
+    packed[lane[order], pos] = sub[order]
+    # [128, per_lane] -> [chunks, A, 128]
+    packed = packed.reshape(128, chunks, a).transpose(1, 2, 0)
+    slot = np.empty(len(idx), np.int64)
+    # packed position (lane l, stream index p) -> flat slot in the
+    # [chunks, A, 128] output: chunk = p // a, sublane = p % a, lane l.
+    slot[order] = ((pos // a) * a * 128 + (pos % a) * 128
+                   + lane[order])
+    return packed, slot
+
+
+def make_pallas_residue_gather(w, sub_chunks, interpret=False):
+    """Whole table in VMEM as [d/128, 128]; one lane-local
+    dynamic_gather per same-shape index chunk — the ONLY arbitrary-
+    gather formulation Mosaic's gather lowering supports (jax pallas
+    mosaic lowering.py:2464-2525: batched 2-D take_along_axis with
+    slice_sizes (1,1); flat 1-D gathers raise 'Only 2D gather')."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    m = idx.shape[0]
-    tile = 8 * 128
-    mp = -(-m // tile) * tile
-    idx_p = jnp.pad(idx, (0, mp - m)).reshape(mp // tile, 8, 128)
+    chunks, a, lanes = sub_chunks.shape
+    w2 = jnp.asarray(w).reshape(a, lanes)
 
     def kernel(w_ref, idx_ref, out_ref):
-        out_ref[0] = w_ref[:][idx_ref[0]]
+        out_ref[0] = jnp.take_along_axis(w_ref[:], idx_ref[0], axis=0)
 
     f = pl.pallas_call(
         kernel,
-        grid=(mp // tile,),
+        grid=(chunks,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),  # whole table
-            pl.BlockSpec((1, 8, 128), lambda t: (t, 0, 0),
+            pl.BlockSpec((1, a, lanes), lambda t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 8, 128), lambda t: (t, 0, 0),
+        out_specs=pl.BlockSpec((1, a, lanes), lambda t: (t, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((mp // tile, 8, 128), w.dtype),
+        out_shape=jax.ShapeDtypeStruct((chunks, a, lanes), w.dtype),
         interpret=interpret,
     )
-    jf = jax.jit(lambda w, i: f(w, i).reshape(-1)[:m])
-    return lambda: jf(w, idx_p)
+    jf = jax.jit(lambda wt, i: f(wt, i).reshape(-1))
+    sc = jnp.asarray(sub_chunks)
+    return lambda: jf(w2, sc)
 
 
 def _time(fn, reps=5):
@@ -206,27 +245,28 @@ def run(m, d, check=False):
     idx = jnp.asarray(idx_np)
     local, mask, slot = _prep_blocks(idx_np, d)
     local_j, mask_j = jnp.asarray(local), jnp.asarray(mask)
+    res_chunks, res_slot = _prep_residue(idx_np, d)
     expect = w_np[idx_np]
 
-    def verify(packed_fn, packed=True):
-        out = np.asarray(packed_fn())
-        got = out[slot] if packed else out
+    def verify(fn, slot_map):
+        out = np.asarray(fn())
+        got = out[slot_map] if slot_map is not None else out
         np.testing.assert_allclose(got, expect, atol=2e-2)
         return True
 
     candidates = {
-        "xla_gather": (make_xla_gather(w, idx), False),
-        "xla_onehot_scan": (make_xla_onehot_scan(w, local_j, mask_j), True),
+        "xla_gather": (make_xla_gather(w, idx), None),
+        "xla_onehot_scan": (make_xla_onehot_scan(w, local_j, mask_j), slot),
         "pallas_onehot": (make_pallas_onehot(w, local_j, mask_j,
-                                             interpret=interpret), True),
-        "pallas_vmem_gather": (make_pallas_vmem_gather(w, idx,
-                                                       interpret=interpret),
-                               False),
+                                             interpret=interpret), slot),
+        "pallas_residue_gather": (
+            make_pallas_residue_gather(w, res_chunks, interpret=interpret),
+            res_slot),
     }
     results = {}
-    for name, (fn, packed) in candidates.items():
+    for name, (fn, slot_map) in candidates.items():
         try:
-            verify(fn, packed)
+            verify(fn, slot_map)
             dt = _time(fn) if not check else float("nan")
             results[name] = {"ok": True,
                              "mlookups_per_sec": (round(m / dt / 1e6, 1)
