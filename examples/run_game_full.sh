@@ -14,6 +14,14 @@ OUT_DIR="${OUT_DIR:-example-out/game-full}"
 [ -d "$DATA_DIR/game-full/train" ] || python examples/generate_example_data.py --data-dir "$DATA_DIR"
 rm -rf "$OUT_DIR"
 
+# Build the feature index as PARTITIONED PALDB STORES (the reference's
+# FeatureIndexingJob artifact — written by this package's own writer,
+# then read back by the training driver: full round-trip interop).
+python -m photon_ml_tpu.cli.feature_indexing \
+  --data-path "$DATA_DIR/game-full/train" \
+  --output-dir "$OUT_DIR/feature-index" \
+  --format paldb --partition-num 2 --shard-name global
+
 # Optimizer mini-DSL: maxIter,tol,lambda,downSampleRate,optimizer,regType
 #  - fixed:     TRON + L2 (trust-region Newton-CG, TRON.scala defaults)
 #  - perUser:   L-BFGS/OWL-QN + ELASTIC_NET (alpha folded via regType)
@@ -21,6 +29,7 @@ rm -rf "$OUT_DIR"
 python -m photon_ml_tpu.cli.game_training_driver \
   --train-input-dirs "$DATA_DIR/game-full/train" \
   --validate-input-dirs "$DATA_DIR/game-full/validate" \
+  --feature-index-dir "$OUT_DIR/feature-index" \
   --output-dir "$OUT_DIR/model" \
   --task-type LOGISTIC_REGRESSION \
   --fixed-effect-data-configurations "fixed:global" \
