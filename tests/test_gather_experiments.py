@@ -1,0 +1,54 @@
+"""Host-side packing logic of dev_scripts/gather_experiments.py — the
+block-packed (one-hot MXU) and residue-class (lane-local dynamic_gather)
+index layouts must be exact permutations, or chip measurements of the
+gather-wall candidates would validate garbage."""
+
+import numpy as np
+
+from dev_scripts.gather_experiments import BLOCK, _prep_blocks, _prep_residue
+
+
+def test_prep_blocks_is_exact_permutation():
+    rng = np.random.default_rng(5)
+    d = 6 * BLOCK + 17  # ragged final block
+    m = 5000
+    idx = rng.integers(0, d, m).astype(np.int32)
+    local, mask, slot = _prep_blocks(idx, d)
+    kb, e = local.shape
+    assert kb == -(-d // BLOCK)
+    assert mask.sum() == m
+    # Reconstruct each entry's global index from its packed slot.
+    flat_local = local.reshape(-1)
+    owner_of_slot = np.repeat(np.arange(kb), e)
+    got = owner_of_slot[slot] * BLOCK + flat_local[slot]
+    np.testing.assert_array_equal(got, idx)
+    # Padding slots carry mask 0 and in-range local ids.
+    assert (local >= 0).all() and (local < BLOCK).all()
+
+
+def test_prep_residue_is_exact_permutation():
+    rng = np.random.default_rng(7)
+    d = 128 * 57
+    m = 4096
+    idx = rng.integers(0, d, m).astype(np.int32)
+    packed, slot = _prep_residue(idx, d)
+    chunks, a, lanes = packed.shape
+    assert lanes == 128 and a == d // 128
+    # Every lane's entries are its own residue class (the dynamic_gather
+    # lane-locality contract).
+    flat = packed.reshape(-1)  # [chunks * a * 128], lane = pos % 128
+    got = flat[slot] * 128 + (slot % 128)
+    np.testing.assert_array_equal(got, idx)
+
+
+def test_prep_residue_skewed_distribution_pads_chunks():
+    # All indices share one residue class: per-lane stream is maximally
+    # skewed and must round up to whole table-shaped chunks.
+    d = 128 * 8
+    idx = (np.arange(500, dtype=np.int32) % 8) * 128 + 5  # residue 5 only
+    packed, slot = _prep_residue(idx, d)
+    chunks, a, lanes = packed.shape
+    assert a == 8 and chunks == -(-500 // 8)
+    flat = packed.reshape(-1)
+    got = flat[slot] * 128 + (slot % 128)
+    np.testing.assert_array_equal(got, idx)
