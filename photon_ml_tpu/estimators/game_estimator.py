@@ -40,12 +40,18 @@ logger = logging.getLogger(__name__)
 
 @dataclasses.dataclass
 class FixedEffectSpec:
+    """``feature_sharding``: shard coefficient columns over the mesh's
+    model axis (rows simultaneously over the data axis on a 2-D mesh) —
+    the d-beyond-HBM regime (GameEstimator.scala:330-334's >200k-feature
+    treeAggregate depth analog)."""
+
     name: str
     feature_shard_id: str
     configs: Sequence[GLMOptimizationConfiguration]
     normalization: Optional[object] = None
     lower_bounds: Optional[object] = None
     upper_bounds: Optional[object] = None
+    feature_sharding: bool = False
 
 
 @dataclasses.dataclass
@@ -150,6 +156,7 @@ class GameEstimator:
                         normalization=s.normalization, dtype=self.dtype,
                         lower_bounds=s.lower_bounds,
                         upper_bounds=s.upper_bounds,
+                        feature_sharding=s.feature_sharding,
                         mesh=self.mesh)
                 elif isinstance(s, FactoredRandomEffectSpec):
                     cfg = configs[s.name]
