@@ -176,16 +176,6 @@ class FixedEffectCoordinate(Coordinate):
                 shifts=(None if norm_solve.shifts is None else jnp.pad(
                     norm_solve.shifts, (0, pad))))
         self._norm_solve = norm_solve
-        # Bounds are original-space boxes (OptimizationUtils.scala:53);
-        # the solve happens in the normalized space, so transform them
-        # (exact per-coordinate for positive factors; finite intercept
-        # bounds with shifts are rejected).
-        from photon_ml_tpu.data.normalization import (
-            bounds_to_normalized_space,
-        )
-
-        self._lb_solve, self._ub_solve = bounds_to_normalized_space(
-            self.lower_bounds, self.upper_bounds, self.normalization)
         self._objective = GLMObjective(
             loss_for_task(self.task_type), norm_solve)
         # Penalty scalars as PYTHON floats: they constant-fold into the
@@ -245,10 +235,13 @@ class FixedEffectCoordinate(Coordinate):
     def step_data(self):
         # _norm_solve (padded to the sharded width when feature sharding
         # is on) is what the solve-space transforms inside _solve_fixed
-        # must use; bounds ride in the solve space too. Penalties on
-        # unpadded params use self.normalization.
-        return (self._batch, self._norm_solve, self._lb_solve,
-                self._ub_solve)
+        # must use. Bounds clamp the solve-space iterate directly —
+        # reference semantics (the Breeze iterate IS the normalized-space
+        # vector; projectCoefficientsToHypercube clamps it raw,
+        # LBFGS.scala:77). Penalties on unpadded params use
+        # self.normalization.
+        return (self._batch, self._norm_solve, self.lower_bounds,
+                self.upper_bounds)
 
     def params_of(self, model: FixedEffectModel) -> Array:
         return model.glm.coefficients.means
@@ -319,22 +312,20 @@ class RandomEffectCoordinate(Coordinate):
             raise ValueError(
                 "normalization on a projected random-effect dataset is "
                 "not supported — latent columns are not global features")
-        from photon_ml_tpu.data.normalization import (
-            gathered_bounds_to_normalized_space,
-        )
-
         self._norm_blocks = tuple(
             _gather_block_normalization(self.normalization, b)
             for b in self.dataset.blocks)
-        # Bounds are ORIGINAL-space per-feature boxes (the reference's
-        # constraintMap semantics, OptimizationUtils.scala:53); the solve
-        # runs in the normalized space, so convert them (factor > 0 makes
-        # the per-coordinate box transform exact).
+        # Bounds clamp the SOLVE-SPACE (normalized) coefficients — the
+        # reference's exact semantics: its optimizer iterate is the
+        # normalized-space vector (the aggregators compute margins via
+        # effectiveCoefficients = coef :* factors,
+        # ValueAndGradientAggregator.scala:100-120) and
+        # projectCoefficientsToHypercube clamps that iterate against the
+        # raw constraint values (LBFGS.scala:77,
+        # OptimizationUtils.scala:53). No space conversion.
         self._bounds_blocks = tuple(
-            gathered_bounds_to_normalized_space(
-                _gather_block_bounds(self.lower_bounds, self.upper_bounds,
-                                     b), norm)
-            for b, norm in zip(self.dataset.blocks, self._norm_blocks))
+            _gather_block_bounds(self.lower_bounds, self.upper_bounds, b)
+            for b in self.dataset.blocks)
 
     def initialize_model(self) -> RandomEffectModel:
         dt = (self.dataset.blocks[0].x.dtype if self.dataset.blocks

@@ -161,67 +161,16 @@ def gathered_to_original_space(coef, factors, shifts, intercept_mask):
     return out
 
 
-def _check_intercept_unbounded(lower, upper, is_intercept) -> None:
-    for b in (lower, upper):
-        if b is None:
-            continue
-        vals = np.asarray(b)
-        if np.isfinite(np.where(np.asarray(is_intercept), vals, np.nan)
-                       ).any():
-            raise ValueError(
-                "box constraints on the intercept column are not supported "
-                "together with shift normalization (the intercept absorbs "
-                "the margin shift, so an original-space box on it is not a "
-                "box in the solve space)")
-
-
-def bounds_to_normalized_space(lower, upper, norm):
-    """Original-space box bounds -> solve-space bounds.
-
-    The reference keeps the optimizer's iterate in the ORIGINAL space
-    (normalization lives inside the objective) and projects it there
-    (OptimizationUtils.projectCoefficientsToHypercube, applied at
-    LBFGS.scala:77); this codebase optimizes in the NORMALIZED space, so
-    the equivalent constraint is the transformed box: for factor > 0,
-    w in [lb, ub]  <=>  w' = w/factor in [lb/factor, ub/factor]. The
-    intercept coordinate's transform also absorbs shifts from OTHER
-    coordinates, so a finite intercept bound cannot be expressed — it is
-    rejected (reference constraint maps are per feature name and never
-    constrain the intercept in practice)."""
-    if norm is None or (norm.factors is None and norm.shifts is None):
-        return lower, upper
-    if lower is None and upper is None:
-        return lower, upper
-    if norm.shifts is not None:
-        d = len(np.asarray(lower if lower is not None else upper))
-        is_int = np.arange(d) == norm.intercept_id
-        _check_intercept_unbounded(lower, upper, is_int)
-    if norm.factors is not None:
-        if not (np.asarray(norm.factors) > 0).all():
-            raise ValueError("normalization factors must be positive")
-        if lower is not None:
-            lower = jnp.asarray(lower) / norm.factors
-        if upper is not None:
-            upper = jnp.asarray(upper) / norm.factors
-    return lower, upper
-
-
-def gathered_bounds_to_normalized_space(bounds, norm_arrays):
-    """The per-entity (gathered-arrays) version of
-    bounds_to_normalized_space: bounds = (lower, upper) [E, d] in the
-    original space, norm_arrays = (factors, shifts, intercept_mask)."""
-    if bounds is None or norm_arrays is None:
-        return bounds
-    lower, upper = bounds
-    factors, shifts, mask = norm_arrays
-    if shifts is not None:
-        _check_intercept_unbounded(lower, upper, np.asarray(mask) > 0)
-    if factors is not None:
-        if not (np.asarray(factors) > 0).all():
-            raise ValueError("normalization factors must be positive")
-        lower = lower / factors
-        upper = upper / factors
-    return lower, upper
+# NOTE on box constraints + normalization: no bounds transform lives
+# here ON PURPOSE. The reference clamps its optimizer ITERATE against
+# the raw constraint values (projectCoefficientsToHypercube,
+# LBFGS.scala:77), and that iterate is the NORMALIZED-space coefficient
+# vector — the aggregators compute margins via effectiveCoefficients =
+# coef :* factors (ValueAndGradientAggregator.scala:100-120), with the
+# final model transformed to the original space afterwards. Matching
+# semantics here means passing user bounds untransformed into the
+# normalized-space solve (coordinates.py / model_training.py do exactly
+# that).
 
 
 def build_normalization_context(

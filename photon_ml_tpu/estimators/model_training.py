@@ -96,15 +96,14 @@ def train_glm_models(
     objective = GLMObjective(loss_for_task(task), normalization)
     glm_cls = model_for_task(task)
 
+    # Box constraints clamp the SOLVE-SPACE iterate — the reference's
+    # semantics exactly: its optimization variable is the normalized-
+    # space vector (effectiveCoefficients = coef :* factors inside the
+    # aggregators, ValueAndGradientAggregator.scala:100-120) and
+    # projectCoefficientsToHypercube clamps it against the raw
+    # constraint values (LBFGS.scala:77).
     lb = None if lower_bounds is None else jnp.asarray(lower_bounds, dtype)
     ub = None if upper_bounds is None else jnp.asarray(upper_bounds, dtype)
-    # Box constraints are ORIGINAL-space per-feature bounds (the
-    # reference projects the original-space iterate,
-    # OptimizationUtils.scala:53 applied at LBFGS.scala:77); this solve
-    # runs in the normalized space, so transform the box exactly.
-    from photon_ml_tpu.data.normalization import bounds_to_normalized_space
-
-    lb, ub = bounds_to_normalized_space(lb, ub, normalization)
 
     order = sorted(regularization_weights, reverse=True)
     coef = jnp.zeros((d,), dtype)

@@ -148,25 +148,31 @@ def test_random_effect_spec_normalization_through_estimator(rng):
 
     cap = 0.4
     coefs_box = fit(norm, lb=np.full(d, -cap), ub=np.full(d, cap))
-    active = np.abs(coefs_plain) > cap + 0.05
-    assert active.any(), "test problem never activates the box"
-    assert (coefs_box <= cap + 1e-6).all()
-    assert (coefs_box >= -cap - 1e-6).all()
+    # Bounds clamp the SOLVE-SPACE coefficients (reference semantics:
+    # the projected iterate is the normalized-space vector). Blocks'
+    # local columns are the sorted global columns here (single shard,
+    # all observed), so dividing by the global factors recovers w'.
+    factors = np.asarray(norm.factors)
+    solve_plain = coefs_plain[:, :d] / factors[None, :]
+    solve_box = coefs_box[:, :d] / factors[None, :]
+    assert (np.abs(solve_plain) > cap + 0.05).any(), \
+        "test problem never activates the box"
+    assert (np.abs(solve_box) <= cap + 1e-5).all()
 
 
-def test_train_glm_bounds_apply_in_original_space(rng):
+def test_train_glm_bounds_clamp_solve_space(rng):
     """train_glm_models with normalization + box constraints: the box
-    constrains ORIGINAL-space coefficients (reference:
-    OptimizationUtils.projectCoefficientsToHypercube on the original-
-    space iterate) — with factor normalization the strong coefficient
-    clamps at the raw cap, not cap*factor."""
+    clamps the SOLVE-SPACE iterate — reference semantics (the Breeze
+    iterate is the normalized-space vector, effectiveCoefficients =
+    coef :* factors in ValueAndGradientAggregator.scala:100-120, and
+    projectCoefficientsToHypercube clamps it raw at LBFGS.scala:77)."""
     from photon_ml_tpu.estimators.model_training import train_glm_models
 
     n, d = 400, 4
     x = rng.normal(0, 1.0, (n, d))
     x[:, 0] = 1.0
-    x[:, 1] *= 10.0  # big scale -> factor 0.1
-    w_orig = np.array([0.1, 0.25, -1.4, 0.8])  # col 1 orig coef ~0.25
+    x[:, 1] *= 10.0  # big scale -> factor ~0.1
+    w_orig = np.array([0.1, 0.25, -1.4, 0.8])
     y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_orig)))).astype(float)
     norm = build_normalization_context(
         "SCALE_WITH_STANDARD_DEVIATION",
@@ -180,8 +186,14 @@ def test_train_glm_bounds_apply_in_original_space(rng):
         lower_bounds=np.full(d, -cap), upper_bounds=np.full(d, cap),
         max_iterations=150, tolerance=1e-10)
     coefs = np.asarray(trained[0].model.coefficients.means)
-    assert (np.abs(coefs) <= cap + 1e-6).all(), coefs
-    # The strong negative coefficient (|w|~1.4 unconstrained) clamps at
-    # the RAW cap; solve-space application would leave it at a different
-    # magnitude entirely.
-    assert np.isclose(np.abs(coefs).max(), cap, atol=1e-3), coefs
+    solve_space = np.asarray(norm.model_to_normalized_space(
+        jnp.asarray(coefs)))
+    assert (np.abs(solve_space) <= cap + 1e-6).all(), solve_space
+    # The box is ACTIVE: the strong coefficient (solve-space |w'|~1.4
+    # unconstrained since std(col2)~1) clamps at the cap...
+    assert np.isclose(np.abs(solve_space).max(), cap, atol=1e-3)
+    # ...and the ORIGINAL-space coefficient on the scaled column 1
+    # equals w'_1 * factor_1 — bounded by cap/std(col1) ~ 0.06, far
+    # below the raw cap (the solve-space semantics made visible).
+    std1 = float(np.std(x[:, 1]))
+    assert np.abs(coefs[1]) <= cap / std1 * 1.05

@@ -252,11 +252,8 @@ def test_re_coordinate_normalized_kernel_matches_fallback(monkeypatch, rng):
         jnp.asarray(1.0 / std, jnp.float32).at[0].set(1.0),
         jnp.asarray(x.mean(axis=0), jnp.float32).at[0].set(0.0),
         intercept_id=0)
-    # Original-space boxes; the intercept must stay unbounded when shift
-    # normalization is active (the coordinate rejects it otherwise).
     lb = np.full(d, -0.5, np.float32)
     ub = np.full(d, 0.5, np.float32)
-    lb[0], ub[0] = -np.inf, np.inf
     cfg = GLMOptimizationConfiguration(
         max_iterations=30, tolerance=1e-7, regularization_weight=1.0,
         regularization_context=RegularizationContext(RegularizationType.L2))
@@ -377,11 +374,14 @@ def test_norm_bounds_compose_with_entity_sharding(monkeypatch, rng):
     np.testing.assert_array_equal(np.asarray(sharded.iterations[e:]), 0)
 
 
-def test_bounds_constrain_original_space_coefficients(rng):
-    """Reference semantics (OptimizationUtils.projectCoefficientsToHypercube
-    applied to the ORIGINAL-space iterate, LBFGS.scala:77): with factor
-    normalization active, converged original-space coefficients clamp at
-    the RAW bound values — not at bound/factor."""
+def test_bounds_clamp_solve_space_coefficients(rng):
+    """Reference semantics: the optimizer ITERATE is the normalized-space
+    coefficient vector (effectiveCoefficients = coef :* factors,
+    ValueAndGradientAggregator.scala:100-120) and
+    projectCoefficientsToHypercube clamps it against the RAW constraint
+    values (LBFGS.scala:77) — so with factor normalization, the
+    SOLVE-SPACE coefficients respect the box and the original-space
+    model clamps at bound*factor."""
     from photon_ml_tpu.algorithm.coordinates import RandomEffectCoordinate
     from photon_ml_tpu.data.game_data import GameDataset
     from photon_ml_tpu.data.random_effect import (
@@ -420,13 +420,20 @@ def test_bounds_constrain_original_space_coefficients(rng):
         lower_bounds=lb, upper_bounds=ub)
     model, _ = coord.update_model(coord.initialize_model(), None,
                                   jax.random.PRNGKey(0))
-    coefs = np.concatenate([np.asarray(c).ravel()
-                            for c in model.local_coefs])
-    # Original-space coefficients respect the ORIGINAL-space box...
-    assert (coefs <= cap + 1e-4).all() and (coefs >= -cap - 1e-4).all()
-    # ...and the strong coefficient actually hits the raw cap (it would
-    # sit at cap*factor = 0.07 if bounds were applied in solve space).
-    assert coefs.max() > cap - 0.05, coefs
+    coefs = np.concatenate([np.asarray(c)
+                            for c in model.local_coefs], axis=0)
+    coefs = coefs[:, :d]  # strip padding columns (local cols 0..d-1
+    # map to global cols 0..d-1: single entity set, all observed)
+    # Solve-space coefficients (w' = w / factor; no shifts here) respect
+    # the box...
+    solve_space = coefs / np.asarray(factors)[None, :]
+    assert (np.abs(solve_space) <= cap + 1e-4).all(), solve_space
+    # ...the box is actually ACTIVE (the unconstrained solve-space
+    # coefficient on the strong column exceeds the cap)...
+    assert np.isclose(np.abs(solve_space).max(), cap, atol=1e-3)
+    # ...and the ORIGINAL-space coefficient on the hard-scaled column 1
+    # (factor 0.1) therefore clamps at cap*factor, NOT at the raw cap.
+    assert np.abs(coefs[:, 1]).max() <= cap * 0.1 + 1e-4, coefs
 
 
 def test_mesh_sharded_coordinate_with_shift_normalization(rng):
