@@ -782,12 +782,14 @@ def pallas_entity_lbfgs(
     ``factors``/``shifts`` fold per-entity feature normalization into
     the kernel (x' = (x - shift) .* factor computed once in VMEM;
     NormalizationContext.scala:38-83 semantics). Coefficients in and out
-    are in the SOLVE (normalized) space — callers own the space
-    transforms. ``lower``/``upper`` activate projected L-BFGS
-    ("lbfgs" mode only; matching optimization/lbfgs.py's projected
-    trial semantics). Returns an OptimizerResult with [E]-leading
-    leaves (value / gradient-norm histories are not tracked on this
-    path — None)."""
+    are in the SOLVE (normalized) space — callers own the model-space
+    transforms. ``lower``/``upper`` activate projected L-BFGS ("lbfgs"
+    mode only) and clamp the solve-space iterate directly — the
+    reference's exact constraint semantics (its projected Breeze iterate
+    is the normalized-space vector, LBFGS.scala:77) and the same trial
+    projection as optimization/lbfgs.py. Returns an OptimizerResult
+    with [E]-leading leaves (value / gradient-norm histories are not
+    tracked on this path — None)."""
     e, r, d = x.shape
     dtype = x.dtype
     ep = -(-e // LANES) * LANES
