@@ -1,7 +1,9 @@
 """Benchmark: GAME coordinate-descent throughput on the real chip.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+Output contract (VERDICT r4 weak #2): stdout's FINAL line is a COMPACT
+headline JSON (<500 bytes — metric/value/unit/vs_baseline/provenance) that
+survives any tail-window capture; the FULL result (all extras) is written
+to BENCH_full.json next to this file.
 
 Workloads — the full BASELINE.json config matrix:
 - headline — GLMix (config 4): fixed effect (200k x 200, logistic) +
@@ -516,6 +518,117 @@ def game_full_phase_ms():
                     "FactoredRandomEffectCoordinate.scala:99-165"}
 
 
+def ingest_rows_per_sec():
+    """Host Avro→CSR ingest throughput (VERDICT r4 item 7): the reference
+    parallelizes decode across Spark executors (AvroDataReader.scala:86-214);
+    here ONE host feeds the chip, so rows/sec of the native C block decoder
+    (native/_avro_native.c decode_training_block) vs the pure-python
+    record-at-a-time path decides when ingest bottlenecks end-to-end
+    wallclock (crossover analysis: docs/SCALE.md §Host ingest)."""
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.data.avro_reader import (
+        build_index_map,
+        read_labeled_points,
+    )
+    from photon_ml_tpu.data.fast_ingest import fast_ingest
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+
+    n, py_n, d, per_row = ((60_000, 8_000, 5_000, 20)
+                           if SHAPE_SCALE == "full"
+                           else (8_000, 2_000, 1_000, 20))
+    rng = np.random.default_rng(11)
+    # Distinct columns per row (slot j draws from residue class j mod
+    # per_row) — duplicate (name, term) features are rejected at ingest,
+    # matching the reference (AvroDataReader.scala:306-311).
+    cols = (rng.integers(0, d // per_row, (n, per_row)) * per_row
+            + np.arange(per_row))
+    vals = rng.normal(0, 1, (n, per_row))
+    labels = (rng.random(n) < 0.5).astype(float)
+
+    def records(k):
+        for i in range(k):
+            yield {
+                "uid": None,
+                "label": labels[i],
+                "features": [
+                    {"name": f"f{c}", "term": None, "value": float(v)}
+                    for c, v in zip(cols[i], vals[i])],
+                "weight": None, "offset": None,
+                "metadataMap": {"userId": f"u{i % 97}"},
+            }
+
+    tmp = tempfile.mkdtemp(prefix="photon_bench_ingest_")
+    try:
+        big = os.path.join(tmp, "big.avro")
+        small = os.path.join(tmp, "small.avro")
+        write_container(big, schemas.TRAINING_EXAMPLE, records(n))
+        write_container(small, schemas.TRAINING_EXAMPLE, records(py_n))
+        imap = build_index_map(big)
+
+        t0 = time.perf_counter()
+        fast = fast_ingest([big], {"global": imap},
+                           {"global": imap.intercept_index},
+                           id_types=["userId"])
+        c_dt = time.perf_counter() - t0
+        if fast is None:
+            raise RuntimeError("native fast path unavailable")
+
+        # Force the pure-python decoder (smaller file, same layout).
+        import photon_ml_tpu.native as nat
+
+        saved = (nat._loaded, nat._module)
+        nat._loaded, nat._module = True, None
+        try:
+            t0 = time.perf_counter()
+            read_labeled_points(small, index_map=imap)
+            py_dt = time.perf_counter() - t0
+        finally:
+            nat._loaded, nat._module = saved
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    c_rps, py_rps = n / c_dt, py_n / py_dt
+    return {
+        "c_rows_per_sec": round(c_rps),
+        "python_rows_per_sec": round(py_rps),
+        "c_speedup": round(c_rps / py_rps, 1),
+        "shape": (f"{n} rows x {per_row} nnz (C) / {py_n} rows (python), "
+                  f"d={d}, TrainingExampleAvro with metadataMap ids"),
+        "note": "host-side (no device); crossover vs solve time in "
+                "docs/SCALE.md §Host ingest",
+    }
+
+
+def scoring_rows_per_sec():
+    """GAME scoring-path throughput (VERDICT r4 item 8): the reference's
+    scoring driver is a first-class production path
+    (cli/game/scoring/Driver.scala:36). Times DeviceGameScorer.score — one
+    jitted dispatch over HBM-resident data — on the full GAME model
+    (fixed + 2 REs + MF)."""
+    from photon_ml_tpu.algorithm import CoordinateDescent
+    from photon_ml_tpu.models.device_scoring import DeviceGameScorer
+    from photon_ml_tpu.types import TaskType
+
+    data = build_problem()
+    cd = CoordinateDescent(build_coords(data, full_game=True),
+                           TaskType.LOGISTIC_REGRESSION)
+    model = cd.run(num_iterations=1).model
+    scorer = DeviceGameScorer(model, data)
+    out = scorer.score(model)
+    _sync(out)
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = scorer.score(model)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    return (data.num_rows / dt,
+            f"{data.num_rows} rows, fixed + per-user RE + per-item RE + MF "
+            f"submodels, HBM-resident dataset, one dispatch per call")
+
+
 def stream_bandwidth_gbps():
     """Measured achievable HBM bandwidth for THE hot access pattern: a
     chained matvec+rmatvec pair over the bench's own X (each reads the
@@ -635,6 +748,16 @@ def main():
         lambda: run_cd(data, num_iterations=5 if not small else 2,
                        normalized=True),
         (float("nan"), None))
+    # Same-shape unnormalized companion (VERDICT r4 weak #2): off-chip the
+    # headline runs FULL shapes while the standardized extra runs reduced
+    # ones, so the normalization-cost ratio needs an unnormalized run at
+    # the SAME (possibly reduced) shapes. On chip both run full shapes and
+    # the companion is the headline itself.
+    if small:
+        unnorm_companion_per_iter, _ = _try(
+            lambda: run_cd(data, num_iterations=2), (float("nan"), None))
+    else:
+        unnorm_companion_per_iter = per_iter
     fe_ms, fe_iters = _try(fe_lbfgs_iter_ms, nanpair)
     fe_bf16_ms, _ = _try(lambda: fe_lbfgs_iter_ms(bf16_storage=True),
                          nanpair)
@@ -645,6 +768,9 @@ def main():
         scale_fe_sparse, (float("nan"), float("nan"), "failed"))
     re_ms, re_entities, re_shape = _try(
         scale_re_100k_entities, (float("nan"), 0, "failed"))
+    ingest = _try(ingest_rows_per_sec, {"note": "failed"})
+    score_rps, score_shape = _try(scoring_rows_per_sec,
+                                  (float("nan"), "failed"))
 
     # Analytic traffic per fixed-effect L-BFGS iteration: the direction
     # matvec and the accepted-point rmatvec each read X once (n*d*4
@@ -684,6 +810,10 @@ def main():
             "game_full_phase_ms": phase_ms,
             "glmix_standardized_cd_iters_per_sec": _round(
                 1.0 / norm_per_iter, 4),
+            "glmix_unnormalized_same_shape_cd_iters_per_sec": _round(
+                1.0 / unnorm_companion_per_iter, 4),
+            "normalization_cost_ratio": _round(
+                norm_per_iter / unnorm_companion_per_iter, 3),
             "fe_lbfgs_iter_ms": _round(fe_ms, 3),
             "fe_lbfgs_iter_ms_bf16_storage": _round(fe_bf16_ms, 3),
             "tron_iter_ms": _round(tron_ms, 3),
@@ -699,16 +829,20 @@ def main():
             "roofline": {
                 "fe_iter_bytes_analytic": fe_bytes,
                 "fe_achieved_gbps": _round(fe_gbps, 1),
-                "fe_util_vs_v5e_peak": _round(fe_gbps / V5E_HBM_GBPS, 3),
+                # Chip-relative utilization is meaningless against CPU
+                # timings — gated on an actual TPU run (VERDICT r4 weak #2).
+                "fe_util_vs_v5e_peak": (_round(fe_gbps / V5E_HBM_GBPS, 3)
+                                        if tpu_ok else None),
                 "pair_probe_gbps_lower_bound": _round(stream, 1),
                 "note": "achieved = analytic bytes / marginal per-iteration "
                         "device time (the ~70 ms remote-dispatch round trip "
                         "amortizes across a solve's iterations in one "
                         "executable). Utilization is quoted against the v5e "
-                        "datasheet 819 GB/s; the isolated matvec+rmatvec "
-                        "probe is a LOWER bound (chained-dependency stalls "
-                        "+ a ~0.14 ms device-loop boundary per rep) and the "
-                        "fused solver iteration exceeds it.",
+                        "datasheet 819 GB/s ONLY when measured on TPU; the "
+                        "isolated matvec+rmatvec probe is a LOWER bound "
+                        "(chained-dependency stalls + a ~0.14 ms device-loop "
+                        "boundary per rep) and the fused solver iteration "
+                        "exceeds it.",
             },
             "scale": {
                 "fe_sparse_lbfgs_iter_ms": _round(big_ms, 2),
@@ -719,13 +853,37 @@ def main():
                 "re_shape": re_shape,
                 "note": "see docs/SCALE.md for the per-chip HBM envelope",
             },
+            "ingest": ingest,
+            "scoring_rows_per_sec": _round(score_rps, 1),
+            "scoring_shape": score_shape,
             "shape_scale": SHAPE_SCALE,
             "vs_baseline_note": "same JAX code on 1 host CPU (no JVM/Spark "
                                 "available to measure the reference itself)",
             "tpu_probe": probe_note,
         },
     }
-    print(json.dumps(result))
+    # Artifact contract (VERDICT r4 weak #2): full result -> file; stdout's
+    # final line is a compact headline that any tail-window capture parses.
+    full_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_full.json")
+    try:
+        with open(full_path, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        print(f"# could not write {full_path}: {e}", file=sys.stderr)
+    compact = {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "vs_baseline": result["vs_baseline"],
+        "provenance": ("tpu" if tpu_ok else
+                       "cpu-intentional" if cpu_intentional else
+                       "cpu-fallback"),
+        "shape_scale": SHAPE_SCALE,
+        "full_result": "BENCH_full.json",
+    }
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":

@@ -1,7 +1,9 @@
-"""Chip validation: all three Pallas entity-solver modes compile and run
-on real TPU, with a timed bucket solve each. Run after any kernel change
-(and after a tunnel outage) before trusting TPU results:
+"""Chip validation: all ten Pallas entity-solver variants (3 modes x
+normalization/bounds folds) run and are timed on real TPU, then the
+gather-wall candidates. Run after any kernel change (and after a tunnel
+outage) before trusting TPU results:
     python dev_scripts/chip_validation.py
+Compile-only certification without a chip: dev_scripts/mosaic_aot_check.py
 """
 def main():
     import time
@@ -61,6 +63,10 @@ def main():
          dict(factors=faca, shifts=shfa)),
         ("tron+norm", "tron", poi_loss, ypa, 0.0, 1.0,
          dict(factors=faca, shifts=shfa)),
+        ("tron+bounds", "tron", poi_loss, ypa, 0.0, 1.0,
+         dict(lower=lba, upper=uba)),
+        ("tron+norm+bounds", "tron", poi_loss, ypa, 0.0, 1.0,
+         dict(factors=faca, shifts=shfa, lower=lba, upper=uba)),
     ]:
         ms, res = timed(lambda: pallas_entity_lbfgs(
             loss, xa, yy, offa, wa, c0, l2, l1,

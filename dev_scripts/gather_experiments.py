@@ -104,45 +104,69 @@ def make_xla_onehot_scan(w, local, mask):
     return lambda: f(w, local, mask)
 
 
-def make_pallas_onehot(w, local, mask, interpret=False):
+def build_onehot_call(kb, e, interpret=False):
+    """The raw pallas_call for the one-hot MXU gather candidate —
+    separated from the data prep so the deviceless Mosaic compile gate
+    (mosaic_aot_check.py) can AOT-compile it from abstract shapes.
+
+    Two Mosaic constraints found by the AOT gate shape the geometry:
+    the block shape's second-to-last dim must divide by 8 (a (1, ep)
+    block fails to lower), and the materialized one-hot intermediate
+    must FIT VMEM — so the grid is 2-D: 8 column-blocks per step along
+    kb, ECOLS=512 entities per step along ep (one-hot tile
+    [512, 2048] bf16 = 2 MB in VREGs, reused across the 8 static-loop
+    2-D dots; no 3-D contraction). kb pads to a multiple of 8, e to a
+    multiple of 512 (pad rows/cols gather w[.] masked to 0)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    kb, e = local.shape
-    d_pad = kb * BLOCK
-    w_pad = jnp.pad(w, (0, d_pad - w.shape[0])).reshape(kb, BLOCK)
-    # e must tile to the MXU's 128-row granularity
-    ep = -(-e // 128) * 128
-    local_p = jnp.pad(local, ((0, 0), (0, ep - e)))
-    mask_p = jnp.pad(mask, ((0, 0), (0, ep - e)))
+    rows = 8  # second-to-last block dim must divide by 8
+    ecols = 512  # entities per grid step: bounds the one-hot VMEM tile
+    kbp = -(-kb // rows) * rows
+    ep = -(-e // ecols) * ecols
 
     def kernel(loc_ref, msk_ref, w_ref, out_ref):
-        loc = loc_ref[:].reshape(ep, 1)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (ep, BLOCK), 1)
-        onehot = (loc == iota).astype(jnp.bfloat16)
-        wv = w_ref[:].reshape(BLOCK, 1).astype(jnp.bfloat16)
-        out = jnp.dot(onehot, wv, preferred_element_type=jnp.float32)
-        out_ref[:] = out.reshape(1, ep) * msk_ref[:]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (ecols, BLOCK), 1)
+        for i in range(rows):
+            loc = loc_ref[i].reshape(ecols, 1)
+            onehot = (loc == iota).astype(jnp.bfloat16)
+            wv = w_ref[i].reshape(BLOCK, 1).astype(jnp.bfloat16)
+            out = jnp.dot(onehot, wv, preferred_element_type=jnp.float32)
+            out_ref[i] = out.reshape(ecols) * msk_ref[i]
 
     f = pl.pallas_call(
         kernel,
-        grid=(kb,),
+        grid=(kbp // rows, ep // ecols),
         in_specs=[
-            pl.BlockSpec((1, ep), lambda b: (b, 0),
+            pl.BlockSpec((rows, ecols), lambda b, c: (b, c),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ep), lambda b: (b, 0),
+            pl.BlockSpec((rows, ecols), lambda b, c: (b, c),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+            pl.BlockSpec((rows, BLOCK), lambda b, c: (b, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, ep), lambda b: (b, 0),
+        out_specs=pl.BlockSpec((rows, ecols), lambda b, c: (b, c),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((kb, ep), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((kbp, ep), jnp.float32),
         interpret=interpret,
     )
-    jf = jax.jit(lambda l, m, wp: f(l, m, wp)[:, :e].reshape(-1))
+    return f, ep, kbp
+
+
+def make_pallas_onehot(w, local, mask, interpret=False):
+    import jax
+    import jax.numpy as jnp
+
+    kb, e = local.shape
+    d_pad = kb * BLOCK
+    w_pad = jnp.pad(w, (0, d_pad - w.shape[0])).reshape(kb, BLOCK)
+    f, ep, kbp = build_onehot_call(kb, e, interpret=interpret)
+    w_pad = jnp.pad(w_pad, ((0, kbp - kb), (0, 0)))
+    local_p = jnp.pad(local, ((0, kbp - kb), (0, ep - e)))
+    mask_p = jnp.pad(mask, ((0, kbp - kb), (0, ep - e)))
+    jf = jax.jit(lambda l, m, wp: f(l, m, wp)[:kb, :e].reshape(-1))
     return lambda: jf(local_p, mask_p, w_pad)
 
 
@@ -178,24 +202,18 @@ def _prep_residue(idx: np.ndarray, d: int):
     return packed, slot
 
 
-def make_pallas_residue_gather(w, sub_chunks, interpret=False):
-    """Whole table in VMEM as [d/128, 128]; one lane-local
-    dynamic_gather per same-shape index chunk — the ONLY arbitrary-
-    gather formulation Mosaic's gather lowering supports (jax pallas
-    mosaic lowering.py:2464-2525: batched 2-D take_along_axis with
-    slice_sizes (1,1); flat 1-D gathers raise 'Only 2D gather')."""
+def build_residue_call(chunks, a, lanes, dtype, interpret=False):
+    """The raw pallas_call for the lane-local dynamic_gather candidate
+    (separated from data prep for the deviceless Mosaic compile gate)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    chunks, a, lanes = sub_chunks.shape
-    w2 = jnp.asarray(w).reshape(a, lanes)
-
     def kernel(w_ref, idx_ref, out_ref):
         out_ref[0] = jnp.take_along_axis(w_ref[:], idx_ref[0], axis=0)
 
-    f = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid=(chunks,),
         in_specs=[
@@ -205,9 +223,23 @@ def make_pallas_residue_gather(w, sub_chunks, interpret=False):
         ],
         out_specs=pl.BlockSpec((1, a, lanes), lambda t: (t, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((chunks, a, lanes), w.dtype),
+        out_shape=jax.ShapeDtypeStruct((chunks, a, lanes), dtype),
         interpret=interpret,
     )
+
+
+def make_pallas_residue_gather(w, sub_chunks, interpret=False):
+    """Whole table in VMEM as [d/128, 128]; one lane-local
+    dynamic_gather per same-shape index chunk — the ONLY arbitrary-
+    gather formulation Mosaic's gather lowering supports (jax pallas
+    mosaic lowering.py:2464-2525: batched 2-D take_along_axis with
+    slice_sizes (1,1); flat 1-D gathers raise 'Only 2D gather')."""
+    import jax
+    import jax.numpy as jnp
+
+    chunks, a, lanes = sub_chunks.shape
+    w2 = jnp.asarray(w).reshape(a, lanes)
+    f = build_residue_call(chunks, a, lanes, w.dtype, interpret=interpret)
     jf = jax.jit(lambda wt, i: f(wt, i).reshape(-1))
     sc = jnp.asarray(sub_chunks)
     return lambda: jf(w2, sc)

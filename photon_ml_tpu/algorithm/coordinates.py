@@ -795,9 +795,10 @@ def _use_pallas_entity_solver(objective, config, x,
     """The fused Pallas kernel covers the random-effect solve
     configurations: TPU backend, L-BFGS (L2, box constraints via
     projected trials) or OWL-QN (L1/elastic-net) or TRON
-    (twice-differentiable losses, L2-only, unbounded), with or without
-    per-entity normalization, dense blocks that fit the kernel's VMEM
-    working set. Mesh-sharded blocks are ALSO kernel-eligible —
+    (twice-differentiable losses, L2-only, box constraints via
+    projected trust-region trials), with or without per-entity
+    normalization, dense blocks that fit the kernel's VMEM working
+    set. Mesh-sharded blocks are ALSO kernel-eligible —
     _solve_block wraps the kernel in shard_map (one kernel per device
     over its entity shard) and passes sharded=False here to express
     that; sharded=True means "sharded with no mesh to scope a
@@ -836,9 +837,6 @@ def _use_pallas_entity_solver(objective, config, x,
         # solve_glm raises for TRON + L1 or a once-differentiable loss;
         # the vmapped fallback preserves those error contracts.
         if l1 > 0 or not objective.loss.twice_differentiable:
-            return False
-        if bounds is not None:
-            _warn_fallback("TRON with box constraints")
             return False
     if bounds is not None and l1 > 0:
         # solve_glm raises for L1 + bounds; preserve the error contract.
@@ -891,8 +889,8 @@ def _solve_block(
     runs per device over the entity-sharded bucket via ``shard_map``
     (each device solves its own 1/n of the entities — entity sharding
     composed with the kernel; sentinel padding entities converge
-    instantly). Remaining fallbacks (oversize VMEM, TRON+bounds, CPU)
-    use the portable vmapped solver."""
+    instantly). Remaining fallbacks (oversize VMEM, CPU) use the
+    portable vmapped solver."""
     offsets = block.offsets
     extra = _gather_residual(residual_scores, block)
     if extra is not None:

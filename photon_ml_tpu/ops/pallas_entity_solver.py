@@ -33,11 +33,12 @@ search with its tail folded in).
 Routing: algorithm/coordinates.py uses this kernel for random-effect
 bucket solves on TPU — L-BFGS with L2 (box constraints via projected
 trials), OWL-QN for L1/elastic-net, or TRON (trust-region Newton-CG,
-twice-differentiable losses). Per-entity feature normalization folds
+twice-differentiable losses, box constraints via projected trust-region
+trials + active-set-reduced CG). Per-entity feature normalization folds
 into all three modes as a one-time x' = (x - shift).*factor transform
 in VMEM. Remaining fallbacks to the vmapped jnp path: oversize-VMEM
-buckets, non-TPU backends, and TRON+bounds. Set
-PHOTON_ML_TPU_NO_PALLAS=1 to disable.
+buckets and non-TPU backends only. Set PHOTON_ML_TPU_NO_PALLAS=1 to
+disable.
 """
 
 from __future__ import annotations
@@ -135,6 +136,23 @@ def _two_loop(g, s_hist, y_hist, rho, count):
         rr = rr + (alphas[j] - beta) * s_hist[j]
     return -rr
 
+
+
+def _tiered_sweep(sweep, active, init_carry, t1, n_trials):
+    """Tier-1 line-search sweep always; the rare tail as a 0/1-trip
+    while_loop. Mosaic legalizes neither a vector-valued scf.if
+    (lax.cond) nor vector<i1> loop carries (KERNEL.md constraint #6),
+    so carry[0] is the found flag as a FLOAT 0/1 mask and the tail
+    trigger is a scalar bool. One shared implementation — the pattern
+    is subtle enough that its copies drifted once already."""
+    carry = sweep(0, t1, init_carry)
+    if n_trials > t1:
+        need_tail = jnp.any(jnp.logical_and(active, carry[0] <= 0))
+        carry = lax.while_loop(
+            lambda c: c[0],
+            lambda c: (jnp.zeros((), bool),) + sweep(t1, n_trials, c[1:]),
+            (need_tail,) + carry)[1:]
+    return carry
 
 
 def _sel(mask, a, b):
@@ -339,11 +357,15 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
                 return armijo, x_t, z_t, f_t
 
             def sweep(k_lo, k_hi, carry):
-                found, x_acc, z_acc, f_acc = carry
+                # The found flag is carried as a FLOAT 0/1 mask, not
+                # bool: Mosaic cannot legalize vector<i1> values carried
+                # through scf.while/scf.if (KERNEL.md constraint #6 —
+                # transient bool masks are fine, loop carries are not).
+                foundf, x_acc, z_acc, f_acc = carry
                 for k in range(k_lo, k_hi):
                     t = init_step * (shrink ** k)
                     a, x_t, z_t, f_t = trial(t)
-                    take = jnp.logical_and(a, ~found)
+                    take = jnp.logical_and(a, foundf <= 0)
                     # 0*inf is NaN in _sel's arithmetic select — an
                     # overflowed (rejected) trial's margins must not
                     # poison the carried accumulator.
@@ -351,18 +373,19 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
                     x_acc = _sel(take, x_t, x_acc)
                     z_acc = _sel(take, z_t, z_acc)
                     f_acc = jnp.where(take, f_t, f_acc)
-                    found = jnp.logical_or(found, a)
-                return found, x_acc, z_acc, f_acc
+                    foundf = jnp.maximum(foundf,
+                                         a.astype(foundf.dtype))
+                return foundf, x_acc, z_acc, f_acc
 
-            t1 = min(n_trials, 8)
-            carry = (jnp.zeros_like(active), st.c, st.z, st.f)
-            carry = sweep(0, t1, carry)
-            if n_trials > t1:
-                need_tail = jnp.any(jnp.logical_and(active, ~carry[0]))
-                carry = lax.cond(need_tail,
-                                 lambda c: sweep(t1, n_trials, c),
-                                 lambda c: c, carry)
-            ok, c_new, z_new, f_new = carry
+            # zeros_like, NOT st.f * 0.0: an overflowed lane (f = inf)
+            # would seed the found-mask with NaN and disable its line
+            # search forever. The constant-zero-carry layout hazard
+            # (constraint #2) does not apply — the mask reaches the
+            # tail while_loop only after tier 1's data-derived updates.
+            okf, c_new, z_new, f_new = _tiered_sweep(
+                sweep, active, (jnp.zeros_like(st.f), st.c, st.z, st.f),
+                min(n_trials, 8), n_trials)
+            ok = okf > 0
 
             g_new = grad_from(c_new, z_new)
             pg_new = pseudo_grad(c_new, g_new)
@@ -401,27 +424,26 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
                 return armijo, x_t, z_t, f_t
 
             def sweep(k_lo, k_hi, carry):
-                found, x_acc, z_acc, f_acc = carry
+                # Float 0/1 found-mask carry — see body_owlqn's sweep
+                # (Mosaic cannot carry vector<i1> through scf loops).
+                foundf, x_acc, z_acc, f_acc = carry
                 for k in range(k_lo, k_hi):
                     t = init_step * (shrink ** k)
                     a, x_t, z_t, f_t = trial(t)
-                    take = jnp.logical_and(a, ~found)
+                    take = jnp.logical_and(a, foundf <= 0)
                     z_t = jnp.where(jnp.isfinite(z_t), z_t, 0.0)
                     x_acc = _sel(take, x_t, x_acc)
                     z_acc = _sel(take, z_t, z_acc)
                     f_acc = jnp.where(take, f_t, f_acc)
-                    found = jnp.logical_or(found, a)
-                return found, x_acc, z_acc, f_acc
+                    foundf = jnp.maximum(foundf,
+                                         a.astype(foundf.dtype))
+                return foundf, x_acc, z_acc, f_acc
 
-            t1 = min(n_trials, 8)
-            carry = (jnp.zeros_like(active), st.c, st.z, st.f)
-            carry = sweep(0, t1, carry)
-            if n_trials > t1:
-                need_tail = jnp.any(jnp.logical_and(active, ~carry[0]))
-                carry = lax.cond(need_tail,
-                                 lambda c: sweep(t1, n_trials, c),
-                                 lambda c: c, carry)
-            ok, c_new, z_new, f_new = carry
+            # zeros_like init, shared tail — see body_owlqn.
+            okf, c_new, z_new, f_new = _tiered_sweep(
+                sweep, active, (jnp.zeros_like(st.f), st.c, st.z, st.f),
+                min(n_trials, 8), n_trials)
+            ok = okf > 0
 
             g_new = grad_from(c_new, z_new)
             gnorm_new = jnp.sqrt(_rsum(g_new * g_new))
@@ -489,17 +511,25 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
 
             ok, t_acc, f_new = price(steps(0, t1))
             if n_trials > t1:
+                # 0/1-trip while_loop, not lax.cond, and the ok flag
+                # rides as a FLOAT 0/1 mask: Mosaic legalizes neither a
+                # vector-valued scf.if nor vector<i1> loop carries
+                # (KERNEL.md constraint #6).
                 need_tail = jnp.any(jnp.logical_and(active, ~ok))
 
-                def with_tail(_):
+                def with_tail(c):
+                    _, okf0, t0, f0 = c
+                    ok0 = okf0 > 0
                     ok2, t2, f2 = price(steps(t1, n_trials))
-                    return (jnp.logical_or(ok, ok2),
-                            jnp.where(ok, t_acc, t2),
-                            jnp.where(ok, f_new, f2))
+                    okf2 = jnp.maximum(okf0, ok2.astype(okf0.dtype))
+                    return (jnp.zeros((), bool), okf2,
+                            jnp.where(ok0, t0, t2),
+                            jnp.where(ok0, f0, f2))
 
-                ok, t_acc, f_new = lax.cond(
-                    need_tail, with_tail,
-                    lambda _: (ok, t_acc, f_new), None)
+                _, okf, t_acc, f_new = lax.while_loop(
+                    lambda c: c[0], with_tail,
+                    (need_tail, ok.astype(st.f.dtype), t_acc, f_new))
+                ok = okf > 0
 
             c_new = st.c + t_acc * direction
             z_new = st.z + t_acc * zp
@@ -529,7 +559,7 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
 def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
                       tol: float, max_cg: int = 20,
                       max_improvement_failures: int = 5,
-                      normalized: bool = False):
+                      normalized: bool = False, bounded: bool = False):
     """TRON (trust-region Newton-CG) per-entity kernel — the same
     LIBLINEAR rules as optimization/tron.py (sigma/eta constants, radius
     interpolation, improvement-failure budget), vectorized over lanes
@@ -538,7 +568,12 @@ def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
     Hv = X^T (d2w * (X v)) + l2 v — two r-row sweeps per CG step.
     Normalization folds in as the same one-time x' = (x - shift).*factor
     transform as the L-BFGS kernel (margins, gradients and Hv all see
-    x')."""
+    x'). Box constraints mirror optimization/tron.py's projected variant
+    (and the reference's per-step hypercube projection, TRON.scala:228):
+    the trial point is clamped onto [lower, upper], CG runs in the
+    active-set-reduced free subspace, predicted reduction is the
+    quadratic model on the REALIZED (projected) step, and stationarity
+    is the projected-gradient norm ||x - P(x - g)||."""
     not_conv = np.int32(int(ConvergenceReason.NOT_CONVERGED))
     ETA0, ETA1, ETA2 = 1e-4, 0.25, 0.75
     SIG1, SIG2, SIG3 = 0.25, 0.5, 4.0
@@ -551,6 +586,9 @@ def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
         if normalized:
             factor_ref, shift_ref = refs[i], refs[i + 1]
             i += 2
+        if bounded:
+            lb_ref, ub_ref = refs[i], refs[i + 1]
+            i += 2
         (out_c_ref, out_f_ref, out_gnorm_ref, out_it_ref,
          out_reason_ref) = refs[i:]
         yv = y_ref[:]
@@ -562,6 +600,12 @@ def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
             fac = factor_ref[:]
             shf = shift_ref[:]
             x_rows = [(xr - shf) * fac for xr in x_rows]
+        if bounded:
+            lb = lb_ref[:]  # [d, L]
+            ub = ub_ref[:]
+
+            def project(c):
+                return jnp.minimum(jnp.maximum(c, lb), ub)
 
         def margins(c):
             return jnp.concatenate(
@@ -577,11 +621,22 @@ def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
                 g = g + x_rows[i] * u[i:i + 1]
             return g
 
+        def stat_norm(c, g):
+            # Stationarity: raw gradient norm unconstrained, projected-
+            # gradient norm ||c - P(c - g)|| with bounds (tron.py's
+            # proj_grad_norm).
+            if not bounded:
+                return jnp.sqrt(_rsum(g * g))
+            pg = c - project(c - g)
+            return jnp.sqrt(_rsum(pg * pg))
+
         c0 = c0_ref[:]
+        if bounded:
+            c0 = project(c0)  # host path projects x0 before evaluating
         z0 = margins(c0)
         f0 = value_from(z0, _rsum(c0 * c0))
         g0 = grad_from(c0, z0)
-        gnorm0 = jnp.sqrt(_rsum(g0 * g0))
+        gnorm0 = stat_norm(c0, g0)
         f0_scale = jnp.maximum(jnp.abs(f0), 1e-30)
 
         # (c, z, f, g, delta, it, fails, reason, gnorm, first, k)
@@ -611,13 +666,37 @@ def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
                     hv = hv + x_rows[i] * u[i:i + 1]
                 return hv
 
+            if bounded:
+                # Active-set reduction (tron.py:174-188): coordinates
+                # pinned at a bound with the gradient pushing outward are
+                # frozen; CG runs in the free subspace so the Newton
+                # model isn't polluted by directions the projection will
+                # clip anyway. [d, L] elementwise mask — no cross-lane
+                # or relayout traffic.
+                eps = 1e-12
+                pinned = jnp.logical_or(
+                    jnp.logical_and(c <= lb + eps, g > 0),
+                    jnp.logical_and(c >= ub - eps, g < 0))
+                free = 1.0 - pinned.astype(c.dtype)
+                g_cg = g * free
+
+                def hvp_cg(v):
+                    return free * hvp(free * v)
+            else:
+                g_cg = g
+                hvp_cg = hvp
+
             # Steihaug-Toint truncated CG, per-lane masked (mirrors
             # _truncated_cg in optimization/tron.py).
-            stop_norm = CG_XI * jnp.sqrt(_rsum(g * g))
+            stop_norm = CG_XI * jnp.sqrt(_rsum(g_cg * g_cg))
 
             def cg_body(cg):
-                s, rres, dvec, rtr, kk, done = cg
-                hd = hvp(dvec)
+                # The done flag rides as a FLOAT 0/1 mask — Mosaic
+                # cannot legalize vector<i1> loop carries (KERNEL.md
+                # constraint #6); bools stay transient inside the body.
+                s, rres, dvec, rtr, kk, donef = cg
+                done = donef > 0
+                hd = hvp_cg(dvec)
                 dhd = _rsum(dvec * hd)
                 alpha = rtr / jnp.where(dhd > 0, dhd, 1.0)
                 s_try = s + alpha * dvec
@@ -642,29 +721,45 @@ def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
                 sel2 = lambda a, b: _sel(done, b, a)  # frozen lanes keep b
                 return (sel2(s_new, s), sel2(r_new, rres),
                         sel2(d_new, dvec), jnp.where(done, rtr, rtr_new),
-                        kk + 1, jnp.logical_or(done, done_new))
+                        kk + 1,
+                        jnp.maximum(donef,
+                                    done_new.astype(donef.dtype)))
 
             def cg_cond(cg):
                 return jnp.logical_and(cg[4] < max_cg,
-                                       jnp.any(~cg[5]))
+                                       jnp.any(cg[5] <= 0))
 
             # Frozen (converged) lanes start CG done — their results are
             # discarded by the outer mask, so running their Hv sweeps
             # would only stretch the lockstep loop for the whole group.
-            cg0 = (g * 0.0, -g, -g, _rsum(g * g), jnp.zeros((), jnp.int32),
+            cg0 = (g_cg * 0.0, -g_cg, -g_cg, _rsum(g_cg * g_cg),
+                   jnp.zeros((), jnp.int32),
                    jnp.logical_or(~active,
-                                  jnp.sqrt(_rsum(g * g)) <= stop_norm))
+                                  jnp.sqrt(_rsum(g_cg * g_cg))
+                                  <= stop_norm).astype(g.dtype))
             s, rres, *_ = lax.while_loop(cg_cond, cg_body, cg0)
 
-            c_try = c + s
+            if bounded:
+                # Clamp the trial and evaluate the quadratic model on
+                # the REALIZED step (tron.py:192-202): the projection
+                # changed the step, so the CG residual identity no
+                # longer prices it — one extra Hv on s_real instead.
+                c_try = project(c + s)
+                s_real = c_try - c
+            else:
+                c_try = c + s
+                s_real = s
             z_try = margins(c_try)
             f_new = value_from(z_try, _rsum(c_try * c_try))
             g_new = grad_from(c_try, z_try)
 
-            gs = _rsum(g * s)
-            prered = -0.5 * (gs - _rsum(s * rres))
+            gs = _rsum(g * s_real)
+            if bounded:
+                prered = -(gs + 0.5 * _rsum(s_real * hvp(s_real)))
+            else:
+                prered = -0.5 * (gs - _rsum(s * rres))
             actred = f - f_new
-            snorm = jnp.sqrt(_rsum(s * s))
+            snorm = jnp.sqrt(_rsum(s_real * s_real))
 
             delta_n = jnp.where(first > 0, jnp.minimum(delta, snorm), delta)
             denom = f_new - f - gs
@@ -705,7 +800,7 @@ def _make_tron_kernel(loss: PointwiseLoss, *, r: int, max_iter: int,
             z_acc = _sel(accept, z_try, z)
             f_acc = jnp.where(accept, f_new, f)
             g_acc = _sel(accept, g_new, g)
-            gnorm_acc = jnp.sqrt(_rsum(g_acc * g_acc))
+            gnorm_acc = stat_norm(c_acc, g_acc)
             f_delta = jnp.abs(f - f_acc)
 
             reason_n = jnp.where(
@@ -783,13 +878,15 @@ def pallas_entity_lbfgs(
     the kernel (x' = (x - shift) .* factor computed once in VMEM;
     NormalizationContext.scala:38-83 semantics). Coefficients in and out
     are in the SOLVE (normalized) space — callers own the model-space
-    transforms. ``lower``/``upper`` activate projected L-BFGS ("lbfgs"
-    mode only) and clamp the solve-space iterate directly — the
+    transforms. ``lower``/``upper`` activate projected L-BFGS or
+    projected TRON ("lbfgs"/"tron" modes; rejected with OWL-QN like
+    solve_glm) and clamp the solve-space iterate directly — the
     reference's exact constraint semantics (its projected Breeze iterate
-    is the normalized-space vector, LBFGS.scala:77) and the same trial
-    projection as optimization/lbfgs.py. Returns an OptimizerResult
-    with [E]-leading leaves (value / gradient-norm histories are not
-    tracked on this path — None)."""
+    is the normalized-space vector, LBFGS.scala:77; TRON projects each
+    trust-region trial onto the hypercube, TRON.scala:228) and the same
+    trial projection as optimization/{lbfgs,tron}.py. Returns an
+    OptimizerResult with [E]-leading leaves (value / gradient-norm
+    histories are not tracked on this path — None)."""
     e, r, d = x.shape
     dtype = x.dtype
     ep = -(-e // LANES) * LANES
@@ -797,9 +894,9 @@ def pallas_entity_lbfgs(
 
     normalized = factors is not None or shifts is not None
     bounded = lower is not None or upper is not None
-    if bounded and mode != "lbfgs":
+    if bounded and mode == "owlqn":
         raise ValueError(
-            f"box constraints are only supported in lbfgs mode, not {mode!r}")
+            "box constraints with L1 are not supported (matching solve_glm)")
 
     def to_lanes(a, trail):
         a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
@@ -838,7 +935,7 @@ def pallas_entity_lbfgs(
 
     if mode == "tron":
         kernel = _make_tron_kernel(loss, r=r, max_iter=max_iter, tol=tol,
-                                   normalized=normalized)
+                                   normalized=normalized, bounded=bounded)
     elif mode in ("lbfgs", "owlqn"):
         kernel = _make_kernel(loss, r=r, max_iter=max_iter, tol=tol, m=m,
                               c1=c1, max_line_search=max_line_search,

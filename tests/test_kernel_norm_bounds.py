@@ -123,7 +123,11 @@ def test_kernel_normalization_matches_vmapped(rng, mode, opt, l1):
                            atol=1e-4)
 
 
-def test_kernel_bounds_match_vmapped(rng):
+@pytest.mark.parametrize("mode,opt", [
+    ("lbfgs", OptimizerType.LBFGS),
+    ("tron", OptimizerType.TRON),
+])
+def test_kernel_bounds_match_vmapped(rng, mode, opt):
     dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
     e, r, d = 33, 6, 5
     x, y, off, w = _bucket(rng, e, r, d, dtype)
@@ -131,7 +135,8 @@ def test_kernel_bounds_match_vmapped(rng):
     obj = GLMObjective(loss)
     cfg = GLMOptimizationConfiguration(
         max_iterations=40, tolerance=1e-8, regularization_weight=0.5,
-        regularization_context=RegularizationContext(RegularizationType.L2))
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        optimizer_type=opt)
     coef0 = jnp.zeros((e, d), dtype)
     # Tight asymmetric box: several coordinates must end up clamped.
     lb = jnp.full((e, d), -0.05, dtype)
@@ -140,7 +145,7 @@ def test_kernel_bounds_match_vmapped(rng):
     res_k = pallas_entity_lbfgs(
         loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
         jnp.asarray(w), coef0, 0.5, lower=lb, upper=ub,
-        max_iter=40, tol=1e-8, interpret=True)
+        max_iter=40, tol=1e-8, mode=mode, interpret=True)
     res_v = _vmapped(obj, cfg, jnp.asarray(x), jnp.asarray(y),
                      jnp.asarray(off), jnp.asarray(w), coef0,
                      lb=lb, ub=ub)
@@ -156,7 +161,11 @@ def test_kernel_bounds_match_vmapped(rng):
                                atol=gold(1e-5, f32_floor=8e-3))
 
 
-def test_kernel_bounds_with_normalization(rng):
+@pytest.mark.parametrize("mode,opt", [
+    ("lbfgs", OptimizerType.LBFGS),
+    ("tron", OptimizerType.TRON),
+])
+def test_kernel_bounds_with_normalization(rng, mode, opt):
     dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
     e, r, d = 17, 5, 4
     scale = np.array([1.0, 8.0, 0.2, 3.0])
@@ -166,7 +175,8 @@ def test_kernel_bounds_with_normalization(rng):
     obj = GLMObjective(loss)
     cfg = GLMOptimizationConfiguration(
         max_iterations=40, tolerance=1e-8, regularization_weight=0.5,
-        regularization_context=RegularizationContext(RegularizationType.L2))
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        optimizer_type=opt)
     coef0 = jnp.zeros((e, d), dtype)
     lb = jnp.full((e, d), -0.08, dtype)
     ub = jnp.full((e, d), 0.15, dtype)
@@ -174,7 +184,8 @@ def test_kernel_bounds_with_normalization(rng):
     res_k = pallas_entity_lbfgs(
         loss, jnp.asarray(x), jnp.asarray(y), jnp.asarray(off),
         jnp.asarray(w), coef0, 0.5, factors=factors, shifts=shifts,
-        lower=lb, upper=ub, max_iter=40, tol=1e-8, interpret=True)
+        lower=lb, upper=ub, max_iter=40, tol=1e-8, mode=mode,
+        interpret=True)
     res_v = _vmapped(obj, cfg, jnp.asarray(x), jnp.asarray(y),
                      jnp.asarray(off), jnp.asarray(w), coef0,
                      factors=factors, shifts=shifts, lb=lb, ub=ub)
@@ -186,15 +197,18 @@ def test_kernel_bounds_with_normalization(rng):
                                atol=gold(1e-5, f32_floor=8e-3))
 
 
-def test_bounds_reject_non_lbfgs_modes():
+def test_bounds_reject_owlqn_mode():
+    """L1 + box constraints stays rejected (matching solve_glm); TRON +
+    bounds is now a supported kernel mode (projected trust region,
+    TRON.scala:228)."""
     e, r, d = 4, 3, 3
     z = jnp.zeros((e, r, d))
     zr = jnp.zeros((e, r))
     zc = jnp.zeros((e, d))
     loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
-    with pytest.raises(ValueError, match="lbfgs mode"):
-        pallas_entity_lbfgs(loss, z, zr, zr, zr, zc, 0.1,
-                            lower=jnp.full((e, d), -1.0), mode="tron",
+    with pytest.raises(ValueError, match="L1"):
+        pallas_entity_lbfgs(loss, z, zr, zr, zr, zc, 0.1, 0.2,
+                            lower=jnp.full((e, d), -1.0), mode="owlqn",
                             interpret=True)
 
 
