@@ -38,15 +38,14 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    from jax.experimental import topologies
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.ops.pallas_entity_solver import pallas_entity_lbfgs
     from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils.aot import v5e_topology
 
-    topo = topologies.get_topology_desc(topology_name="v5e:2x2",
-                                        platform="tpu")
+    topo = v5e_topology()
     sh = NamedSharding(Mesh(np.array(topo.devices[:1]), ("x",)),
                        PartitionSpec())
 
@@ -154,6 +153,31 @@ def main() -> int:
         yield "fe_lbfgs@dpxmp(2x2 chips)", lambda: jax.jit(
             lambda b, x0: fe_fn(b, x0)).lower(
                 batch22, marg22((dfe,), PartitionSpec("model"))).compile()
+
+        # A full v5e-16 slice (4x4): the composed data x model mesh at
+        # the largest single-host v5e topology — collectives lower for
+        # a 16-chip ICI ring, not just the 4-chip square. Topology
+        # creation happens INSIDE the thunk so a libtpu that rejects
+        # the name records as this one check failing, not a gate crash.
+        def check_4x4():
+            topo16 = v5e_topology("v5e:4x4")
+            mesh44 = Mesh(np.array(topo16.devices).reshape(4, 4),
+                          ("data", "model"))
+
+            def marg44(shape, spec, dt=jnp.float32):
+                return jax.ShapeDtypeStruct(
+                    shape, dt, sharding=NamedSharding(mesh44, spec))
+
+            batch44 = GLMBatch(
+                DenseFeatures(marg44((n, dfe),
+                                     PartitionSpec("data", "model"))),
+                marg44((n,), PartitionSpec("data")),
+                marg44((n,), PartitionSpec("data")),
+                marg44((n,), PartitionSpec("data")))
+            return jax.jit(lambda b, x0: fe_fn(b, x0)).lower(
+                batch44, marg44((dfe,), PartitionSpec("model"))).compile()
+
+        yield "fe_lbfgs@dpxmp(4x4 chips)", check_4x4
 
     # Gather-wall candidates (docs/SCALE.md): the two Pallas candidates
     # and the XLA one-hot scan, compiled at the d=2M bench geometry.

@@ -61,7 +61,17 @@ class DenseFeatures:
         return jnp.promote_types(v.dtype, jnp.float32)
 
     def matvec(self, v: Array) -> Array:
-        """x @ v -> [n_rows]. v may have a leading batch dim under vmap."""
+        """x @ v -> [n_rows]. v may have a leading batch dim under vmap.
+
+        With bf16 storage, jnp.matmul's type promotion inserts a
+        convert(x)->f32 — verified HARMLESS on the v5e compile: the
+        convert stays inside the product fusion (temp bytes = 0, X read
+        at storage width), so traffic halves while the multiply-
+        accumulate stays f32. Do NOT 'fix' this by down-casting v to
+        bf16 — that loses precision for zero traffic gain. (XLA's
+        cost-analysis 'bytes accessed' counts the fused convert's
+        virtual output and will claim the bf16 ratio is ~1.0; see
+        bench.aot_fe_cost_analysis.)"""
         return jnp.matmul(self.x, v, preferred_element_type=self._acc(v))
 
     def rmatvec(self, u: Array) -> Array:

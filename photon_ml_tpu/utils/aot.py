@@ -1,0 +1,45 @@
+"""Compile-only TPU topology access for deviceless Mosaic AOT checks.
+
+The image's local libtpu can build a compile-only PJRT client for an
+abstract v5e topology — `jax.jit(...).lower(...).compile()` against its
+devices runs the real Mosaic/XLA TPU compiler with no chip and no
+tunnel (see dev_scripts/mosaic_aot_check.py and docs/KERNEL.md
+§Verification). Shared by bench.py, the AOT gate, and the suite guard
+test so the stale-lockfile recovery exists in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+LOCKFILE = "/tmp/libtpu_lockfile"
+
+
+def v5e_topology(name: str = "v5e:2x2"):
+    """Topology description for an abstract v5e slice.
+
+    libtpu takes a process-exclusive lockfile. A stale lock left by a
+    dead compile-only process is removed and creation retried ONCE —
+    but never when THIS process holds a live TPU backend (an on-chip
+    bench run): yanking a live client's lock could corrupt the one-shot
+    chip capture, and chip timings supersede the compile-only analysis
+    anyway. `jax.default_backend()` is safe here — every caller has
+    already initialized the backend (CPU or TPU), so this cannot trip
+    the wedged-tunnel init hang.
+    """
+    import jax
+    from jax.experimental import topologies
+
+    try:
+        return topologies.get_topology_desc(topology_name=name,
+                                            platform="tpu")
+    except Exception as e:  # noqa: BLE001
+        if ("libtpu_lockfile" not in str(e)
+                or jax.default_backend() == "tpu"):
+            raise
+        try:
+            os.remove(LOCKFILE)
+        except OSError:
+            pass
+        return topologies.get_topology_desc(topology_name=name,
+                                            platform="tpu")

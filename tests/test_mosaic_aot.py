@@ -1,0 +1,56 @@
+"""Deviceless Mosaic compile guard: one fused-kernel variant must
+AOT-compile for a real v5e target using the image's local libtpu
+(no chip needed — see dev_scripts/mosaic_aot_check.py for the full
+matrix). Interpret-mode parity cannot catch Mosaic legalization
+regressions (e.g. vector<i1> loop carries, KERNEL.md constraint #6);
+this keeps at least one real-compiler compile in the suite."""
+
+import functools
+
+import numpy as np
+import pytest
+
+
+def _topology():
+    from photon_ml_tpu.utils.aot import v5e_topology
+
+    try:
+        return v5e_topology()
+    except Exception as e:  # noqa: BLE001 - no libtpu / locked
+        pytest.skip(f"v5e compile-only client unavailable: {e}")
+
+
+def test_entity_kernel_compiles_for_v5e():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.ops.pallas_entity_solver import pallas_entity_lbfgs
+    from photon_ml_tpu.types import TaskType
+
+    if jax.config.jax_enable_x64:
+        # jax 0.9.0: x64 canonicalization recurses infinitely when
+        # lowering this program for the compile-only TPU client; the
+        # f32 suite config (and dev_scripts/mosaic_aot_check.py, which
+        # runs outside the conftest) covers the compile.
+        pytest.skip("v5e AOT lowering hits a JAX recursion bug under x64")
+    topo = _topology()
+    sh = NamedSharding(Mesh(np.array(topo.devices[:1]), ("x",)),
+                       PartitionSpec())
+    e, r, d = 128, 4, 4
+
+    def arg(shape, dt=jnp.float32):
+        return jax.ShapeDtypeStruct(shape, dt, sharding=sh)
+
+    # max_line_search > 8 exercises the tail while_loop (the construct
+    # that regressed); norm+bounds exercises the widest variant.
+    fn = functools.partial(
+        pallas_entity_lbfgs, loss_for_task(TaskType.LOGISTIC_REGRESSION),
+        max_iter=5, tol=1e-6, mode="lbfgs", max_line_search=12)
+    compiled = jax.jit(fn).lower(
+        arg((e, r, d)), arg((e, r)), arg((e, r)), arg((e, r)),
+        arg((e, d)), arg(()), arg(()),
+        factors=arg((e, d)), shifts=arg((e, d)),
+        lower=arg((e, d)), upper=arg((e, d))).compile()
+    assert compiled.memory_analysis().temp_size_in_bytes >= 0
