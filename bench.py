@@ -43,6 +43,23 @@ import time
 
 import numpy as np
 
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: repeated bench runs (and the
+    driver's end-of-round run) reuse compiled executables across
+    processes instead of re-paying ~20-40 s per jit over the remote
+    Mosaic tunnel — the bulk of a cold bench's ~18 min wall."""
+    import jax
+
+    try:
+        path = os.environ.get("PHOTON_JAX_CACHE_DIR",
+                              os.path.expanduser("~/.cache/photon_jax"))
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
 N_ROWS = 200_000
 D_FIXED = 200
 N_USERS = 5_000
@@ -200,23 +217,46 @@ def build_coords(data, full_game=False, normalized=False):
 
 
 def run_cd(data, num_iterations, full_game=False, warmup=None,
-           normalized=False):
+           normalized=False, seed=0):
     """Returns (steady-state seconds per CD iteration, final objective).
 
     Warmup runs the SAME iteration count so the timed run reuses the
-    compiled scan-block executable (block length is a static shape).
-    """
+    compiled scan-block executable (block length is a static shape) —
+    but a DIFFERENT rng seed, so the timed dispatch is never
+    byte-identical to the warmup (relay-side same-args result caching
+    once produced an impossible gather rate on this tunnel —
+    docs/SCALE.md §methodology)."""
     from photon_ml_tpu.algorithm import CoordinateDescent
     from photon_ml_tpu.types import TaskType
 
     cd = CoordinateDescent(build_coords(data, full_game=full_game,
                                         normalized=normalized),
                            TaskType.LOGISTIC_REGRESSION)
-    cd.run(num_iterations=warmup or num_iterations)  # compiles everything
+    cd.run(num_iterations=warmup or num_iterations,
+           seed=seed)  # compiles everything
     t0 = time.perf_counter()
-    res = cd.run(num_iterations=num_iterations)
+    res = cd.run(num_iterations=num_iterations, seed=seed + 1)
     per_iter = (time.perf_counter() - t0) / num_iterations
     return per_iter, res.objective_history[-1]
+
+
+def _marginal_cd(data, lo, hi, reps=2, **kw):
+    """Marginal seconds per CD iteration from two run lengths:
+    (t(hi) - t(lo)) / (hi - lo), best-of-``reps`` per length. Strips the
+    per-dispatch remote-tunnel round trip out of the rate — the RTT
+    varies session-to-session and was the entire difference between the
+    r3 and r5 amortized headlines on identical code. Every underlying
+    run uses a distinct rng seed (see run_cd) — offset so no (length,
+    seed) pair collides with main()'s seed-0 amortized runs either.
+    NaN when the lengths don't separate (dispatch noise > marginal
+    cost)."""
+    t_lo = min(run_cd(data, num_iterations=lo, seed=100 + 10 * r, **kw)[0]
+               for r in range(reps)) * lo
+    t_hi = min(run_cd(data, num_iterations=hi, seed=1000 + 10 * r, **kw)[0]
+               for r in range(reps)) * hi
+    if t_hi > t_lo:
+        return (t_hi - t_lo) / (hi - lo)
+    return float("nan")
 
 
 def _fe_batch(dtype=np.float32, ill_conditioned=False):
@@ -241,18 +281,22 @@ def _fe_batch(dtype=np.float32, ill_conditioned=False):
 
 def _marginal_iter_ms(solve, lo=20, hi=80, reps=3):
     """Marginal ms per optimizer iteration: (t(hi) - t(lo)) / (i_hi - i_lo),
-    with back-to-back repeated solves amortizing the dispatch round trip."""
-    def timed(mi):
-        r = solve(mi)
+    with back-to-back repeated solves amortizing the dispatch round trip.
+    Each call gets a distinct rep index so call sites vary an input
+    microscopically (e.g. x0 + rep * 1e-7): a byte-identical repeat
+    dispatch could be served by relay-side result caching instead of
+    executing (docs/SCALE.md §methodology)."""
+    def timed(mi, rep0):
+        r = solve(mi, rep0)
         _sync(r.x)
         t0 = time.perf_counter()
-        for _ in range(reps):
-            r = solve(mi)
+        for k in range(reps):
+            r = solve(mi, rep0 + 1 + k)
         _sync(r.x)
         return (time.perf_counter() - t0) / reps * 1e3, int(r.iterations)
 
-    t_lo, i_lo = timed(lo)
-    t_hi, i_hi = timed(hi)
+    t_lo, i_lo = timed(lo, 0)
+    t_hi, i_hi = timed(hi, 100)
     if i_hi <= i_lo or t_hi <= t_lo:
         # Converged early, or the shapes are small enough that dispatch
         # noise swamps the marginal difference (reduced off-chip shapes)
@@ -279,8 +323,9 @@ def fe_lbfgs_iter_ms(bf16_storage=False):
     obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
     x0 = np.zeros(D_FIXED, np.float32)
 
-    def solve(mi):
-        return minimize_lbfgs_glm(obj, batch, x0, 1e-3, max_iter=mi, tol=0.0)
+    def solve(mi, rep=0):
+        return minimize_lbfgs_glm(obj, batch, x0 + rep * 1e-7, 1e-3,
+                                  max_iter=mi, tol=0.0)
 
     return _marginal_iter_ms(solve)
 
@@ -303,8 +348,8 @@ def tron_iter_ms():
     obj = GLMObjective(loss_for_task(TaskType.POISSON_REGRESSION))
     x0 = np.zeros(D_FIXED, np.float32)
 
-    def solve(mi):
-        return minimize_tron(obj.value, x0, args=(batch, 1.0),
+    def solve(mi, rep=0):
+        return minimize_tron(obj.value, x0 + rep * 1e-7, args=(batch, 1.0),
                              max_iter=mi, tol=0.0,
                              make_hvp=obj.make_tron_hvp)
 
@@ -335,8 +380,9 @@ def owlqn_iter_ms():
     x0 = np.zeros(D_FIXED, np.float32)
     lam, alpha = 1.0, 0.5  # elastic net: l1 = a*lam, l2 = (1-a)*lam
 
-    def solve(mi):
-        return minimize_owlqn(obj.value, x0, args=(batch, (1 - alpha) * lam),
+    def solve(mi, rep=0):
+        return minimize_owlqn(obj.value, x0 + rep * 1e-7,
+                              args=(batch, (1 - alpha) * lam),
                               l1_weight=alpha * lam, max_iter=mi, tol=0.0)
 
     return _marginal_iter_ms(solve)
@@ -373,9 +419,9 @@ def scale_fe_sparse():
     obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
     x0 = jnp.zeros((feats.n_features,), jnp.float32)
 
-    def solve(mi):
-        return minimize_lbfgs_glm(obj, batch, x0, 1e-2, max_iter=mi,
-                                  tol=0.0)
+    def solve(mi, rep=0):
+        return minimize_lbfgs_glm(obj, batch, x0 + rep * 1e-7, 1e-2,
+                                  max_iter=mi, tol=0.0)
 
     ms, _ = _marginal_iter_ms(solve, lo=5, hi=15, reps=2)
     # A sparse iteration is GATHER-bound: report lookup throughput
@@ -796,6 +842,7 @@ def stream_bandwidth_gbps():
 
 
 def main():
+    _enable_compile_cache()
     if os.environ.get("PHOTON_BENCH_CPU_BASELINE") == "1":
         # Subprocess mode: measure the CPU baseline (1 iteration). The env
         # var alone can be overridden by platform sitecustomize hooks —
@@ -864,8 +911,22 @@ def main():
 
     # Headline always runs at the FULL shape (comparable across rounds,
     # CPU included — measured 1.86 iters/sec on this host in r3).
+    # MARGINAL methodology (round 5, on-chip only): _marginal_cd(10, 20)
+    # isolates steady-state per-iteration cost from the per-dispatch
+    # remote-tunnel round trip. Off-chip there is no tunnel RTT to
+    # strip, so the amortized rate IS the steady-state rate and the
+    # extra full-shape runs would only burn the single CPU core. The
+    # amortized 10-iteration rate is always kept as
+    # extra.glmix_amortized_10it_iters_per_sec for cross-round
+    # continuity, and the unit string names which methodology produced
+    # the headline value.
     data = build_problem()
-    per_iter, objective = run_cd(data, num_iterations=10)
+    amortized_per_iter, objective = run_cd(data, num_iterations=10)
+    marginal_per_iter = (_try(lambda: _marginal_cd(data, 10, 20),
+                              float("nan"))
+                         if tpu_ok else float("nan"))
+    marginal_ok = marginal_per_iter == marginal_per_iter
+    per_iter = marginal_per_iter if marginal_ok else amortized_per_iter
 
     if small:
         # Off-chip, every EXTRA still runs end-to-end — at reduced,
@@ -878,24 +939,43 @@ def main():
         lambda: run_cd(data, num_iterations=5 if not small else 2,
                        full_game=True),
         (float("nan"), None))
+    # Marginal full-GAME rate (same methodology as the headline, so
+    # the full-GAME:GLMix ratio compares steady-state to steady-state
+    # rather than mixing in per-dispatch tunnel latency; on-chip only —
+    # off-chip there is no tunnel RTT to strip). Gated on the HEADLINE
+    # marginal having succeeded: if one side fell back to amortized, the
+    # other must too, or the ratio silently mixes methodologies.
+    full_marginal_ok = False
+    if tpu_ok and marginal_ok:
+        full_marginal = _try(
+            lambda: _marginal_cd(data, 5, 15, full_game=True),
+            float("nan"))
+        if full_marginal == full_marginal:
+            full_per_iter = full_marginal
+            full_marginal_ok = True
     phase_ms = _try(game_full_phase_ms, {"note": "failed"})
     # STANDARDIZATION-active GLMix at the same shapes: the ratio to the
     # headline is the cost of normalization on the fused/kernel paths
     # (should be ~1.0x, never a silent fallback cliff).
+    # Same iteration count as the unnormalized companion on either
+    # branch, so the per-solve dispatch RTT amortizes identically on
+    # both sides of the ratio.
     norm_per_iter, _ = _try(
-        lambda: run_cd(data, num_iterations=5 if not small else 2,
+        lambda: run_cd(data, num_iterations=10 if not small else 2,
                        normalized=True),
         (float("nan"), None))
     # Same-shape unnormalized companion (VERDICT r4 weak #2): off-chip the
     # headline runs FULL shapes while the standardized extra runs reduced
     # ones, so the normalization-cost ratio needs an unnormalized run at
     # the SAME (possibly reduced) shapes. On chip both run full shapes and
-    # the companion is the headline itself.
+    # the companion is the AMORTIZED headline run (same methodology as
+    # the amortized standardized extra, so the ratio compares like with
+    # like).
     if small:
         unnorm_companion_per_iter, _ = _try(
             lambda: run_cd(data, num_iterations=2), (float("nan"), None))
     else:
-        unnorm_companion_per_iter = per_iter
+        unnorm_companion_per_iter = amortized_per_iter
     fe_ms, fe_iters = _try(fe_lbfgs_iter_ms, nanpair)
     fe_bf16_ms, _ = _try(lambda: fe_lbfgs_iter_ms(bf16_storage=True),
                          nanpair)
@@ -941,17 +1021,29 @@ def main():
     except Exception as e:  # noqa: BLE001 - baseline is best-effort
         print(f"# cpu baseline failed: {e}", file=sys.stderr)
 
+    provenance = ("tpu" if tpu_ok else
+                  "cpu-intentional" if cpu_intentional else
+                  "cpu-fallback")
     result = {
         "metric": "game_glmix_cd_iters_per_sec",
         "value": round(1.0 / per_iter, 4),
-        "unit": ("iters/sec (200k rows; d=200 fixed + 5k users x 25 "
-                 "random-effect features)"
+        "provenance": provenance,
+        "unit": (f"iters/sec, {'marginal' if marginal_ok else 'amortized'}"
+                 " (200k rows; d=200 fixed + 5k users "
+                 "x 25 random-effect features)"
                  + (" [CPU FALLBACK]" if fallback else
                     " [CPU]" if cpu_intentional else "")),
         "vs_baseline": (round(baseline_s / per_iter, 2)
                         if baseline_s else None),
         "extra": {
+            "headline_methodology": ("marginal (t(20it)-t(10it))/10"
+                                     if marginal_ok else "amortized 10it"),
+            "glmix_amortized_10it_iters_per_sec": _round(
+                1.0 / amortized_per_iter, 4),
             "game_full_cd_iters_per_sec": _round(1.0 / full_per_iter, 4),
+            "game_full_methodology": ("marginal (t(15it)-t(5it))/10"
+                                      if full_marginal_ok else
+                                      "amortized 5it"),
             "game_full_workload": ("fixed + per-user RE + per-item RE + "
                                    "factored per-item (MF k=4)"),
             "game_full_phase_ms": phase_ms,
@@ -1025,9 +1117,7 @@ def main():
         "value": result["value"],
         "unit": result["unit"],
         "vs_baseline": result["vs_baseline"],
-        "provenance": ("tpu" if tpu_ok else
-                       "cpu-intentional" if cpu_intentional else
-                       "cpu-fallback"),
+        "provenance": provenance,
         "shape_scale": SHAPE_SCALE,
         "full_result": "BENCH_full.json",
     }

@@ -77,6 +77,12 @@ def probe(timeout: float) -> tuple[bool, str]:
 def _run_step(name: str, cmd: list, log_path: str, out_file: str,
               timeout: float) -> bool:
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # dev_scripts/* import photon_ml_tpu from the repo root; python adds
+    # the SCRIPT's dir (not cwd) to sys.path, so the repo must be on
+    # PYTHONPATH — alongside whatever the environment already needs
+    # there (e.g. the axon TPU plugin's site dir).
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     t0 = time.perf_counter()
     try:
         with open(out_file, "w") as f:
@@ -113,6 +119,30 @@ def capture(out_dir: str, log_path: str) -> bool:
     full = os.path.join(REPO, "BENCH_full.json")
     if ok_bench and os.path.exists(full):
         shutil.copy(full, os.path.join(out_dir, f"BENCH_chip_{stamp}.json"))
+    # Best-effort extras LAST (don't gate the capture verdict, and must
+    # not eat a short tunnel window before the primary artifacts): the
+    # sort/scan/scatter primitive rates that decide the sort-permutation
+    # alternative to the random-access wall, then the gather block-width
+    # sweep (docs/SCALE.md §Attacking the gather wall). Skipped when
+    # both primary steps failed — the tunnel is gone and each extra
+    # would burn its full timeout on a dead backend.
+    if ok_val or ok_bench:
+        _run_step(
+            "sort_primitives",
+            [sys.executable,
+             os.path.join(REPO, "dev_scripts", "sort_primitives.py")],
+            log_path, os.path.join(out_dir, f"SORT_PRIMS_{stamp}.log"),
+            timeout=1800)
+        _run_step(
+            "gather_sweep",
+            [sys.executable,
+             os.path.join(REPO, "dev_scripts", "gather_experiments.py"),
+             "--sweep"],
+            log_path, os.path.join(out_dir, f"GATHER_SWEEP_{stamp}.log"),
+            timeout=1800)
+    else:
+        _log(log_path, event="capture:extras_skipped",
+             detail="both primary steps failed; tunnel presumed gone")
     _log(log_path, event="capture:done", ok=ok_val and ok_bench)
     return ok_val and ok_bench
 
