@@ -388,19 +388,26 @@ def owlqn_iter_ms():
     return _marginal_iter_ms(solve)
 
 
-def scale_fe_sparse():
+def scale_fe_sparse(layout="gather"):
     """Scale regime (VERDICT r2 item 2a): sparse fixed effect at d = 2M
-    coefficients, 12M nnz, 250k rows — far beyond the dense envelope,
-    using the degree-bucketed dual-ELL layout (gather-only, padded only
-    within degree classes — see ops/features.py BucketedEllFeatures).
-    Random access on this chip runs at a FLAT ~148M lookups/s (docs/
-    SCALE.md), so slot count is the whole cost model: bucketing packs
-    52M flat-width slots down to ~24.7M (true dual nnz = 24M), measured
-    406 -> ~193 ms per L-BFGS iteration. Returns (marginal ms per
-    iteration, M lookups/s, shape note)."""
+    coefficients, 12M nnz, 250k rows — far beyond the dense envelope.
+    ``layout="gather"`` is the degree-bucketed dual-ELL layout
+    (gather-only, padded only within degree classes — ops/features.py
+    BucketedEllFeatures): random access on this chip runs at a FLAT
+    ~148M lookups/s (docs/SCALE.md), so slot count is the whole cost
+    model — bucketing packs 52M flat-width slots down to ~24.7M (true
+    dual nnz = 24M), measured 406 -> ~193 ms per L-BFGS iteration.
+    ``layout="sort"`` is SortPermuteEllFeatures: the cross-order data
+    movement is a key-sort instead of a slot-sized gather — the
+    measured head-to-head decides whether sort machinery beats the
+    random-access wall (docs/SCALE.md §Attacking the gather wall).
+    Returns (marginal ms per iteration, M lookups/s, shape note)."""
     import jax.numpy as jnp
 
-    from photon_ml_tpu.ops.features import bucketed_ell_from_arrays
+    from photon_ml_tpu.ops.features import (
+        bucketed_ell_from_arrays,
+        sort_permute_ell_from_arrays,
+    )
     from photon_ml_tpu.ops.glm_objective import GLMObjective, make_batch
     from photon_ml_tpu.ops.losses import loss_for_task
     from photon_ml_tpu.optimization.glm_lbfgs import minimize_lbfgs_glm
@@ -413,7 +420,9 @@ def scale_fe_sparse():
     rows = np.repeat(np.arange(n, dtype=np.int64), per_row)
     cols = rng.integers(0, d, nnz)
     vals = rng.normal(0, 1, nnz).astype(np.float32)
-    feats = bucketed_ell_from_arrays(rows, cols, vals, n, d)
+    build = (sort_permute_ell_from_arrays if layout == "sort"
+             else bucketed_ell_from_arrays)
+    feats = build(rows, cols, vals, n, d)
     y = (rng.random(n) < 0.5).astype(np.float32)
     batch = make_batch(feats, jnp.asarray(y))
     obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
@@ -427,7 +436,9 @@ def scale_fe_sparse():
     # A sparse iteration is GATHER-bound: report lookup throughput
     # (matvec + rmatvec process every stored slot once per iteration).
     mlps = feats.num_slots / (ms / 1e3) / 1e6
-    return ms, mlps, (f"d={d} nnz={nnz} rows={n} (bucketed dual-ELL, "
+    kind = ("sort-permute dual-ELL" if layout == "sort"
+            else "bucketed dual-ELL")
+    return ms, mlps, (f"d={d} nnz={nnz} rows={n} ({kind}, "
                       f"{feats.num_slots/1e6:.1f}M slots, "
                       f"{len(feats.row_vals)}+{len(feats.col_vals)} "
                       f"degree groups)")
@@ -942,9 +953,11 @@ def main():
     # Marginal full-GAME rate (same methodology as the headline, so
     # the full-GAME:GLMix ratio compares steady-state to steady-state
     # rather than mixing in per-dispatch tunnel latency; on-chip only —
-    # off-chip there is no tunnel RTT to strip). Gated on the HEADLINE
-    # marginal having succeeded: if one side fell back to amortized, the
-    # other must too, or the ratio silently mixes methodologies.
+    # off-chip there is no tunnel RTT to strip). Only attempted when the
+    # HEADLINE marginal succeeded (a marginal full-GAME against an
+    # amortized headline would mix methodologies); the reverse mix —
+    # marginal headline, full-GAME marginal failing to separate — can
+    # still happen and is flagged in game_full_methodology below.
     full_marginal_ok = False
     if tpu_ok and marginal_ok:
         full_marginal = _try(
@@ -984,6 +997,9 @@ def main():
     stream = _try(stream_bandwidth_gbps, float("nan"))
     big_ms, big_mlps, big_shape = _try(
         scale_fe_sparse, (float("nan"), float("nan"), "failed"))
+    sort_ms, _sort_mlps, sort_shape = _try(
+        lambda: scale_fe_sparse(layout="sort"),
+        (float("nan"), float("nan"), "failed"))
     re_ms, re_entities, re_shape = _try(
         scale_re_100k_entities, (float("nan"), 0, "failed"))
     ingest = _try(ingest_rows_per_sec, {"note": "failed"})
@@ -1041,9 +1057,11 @@ def main():
             "glmix_amortized_10it_iters_per_sec": _round(
                 1.0 / amortized_per_iter, 4),
             "game_full_cd_iters_per_sec": _round(1.0 / full_per_iter, 4),
-            "game_full_methodology": ("marginal (t(15it)-t(5it))/10"
-                                      if full_marginal_ok else
-                                      "amortized 5it"),
+            "game_full_methodology": (
+                "marginal (t(15it)-t(5it))/10" if full_marginal_ok
+                else "amortized 5it (NOT comparable to a marginal "
+                     "headline)" if marginal_ok
+                else "amortized 5it"),
             "game_full_workload": ("fixed + per-user RE + per-item RE + "
                                    "factored per-item (MF k=4)"),
             "game_full_phase_ms": phase_ms,
@@ -1087,6 +1105,8 @@ def main():
                 "fe_sparse_lbfgs_iter_ms": _round(big_ms, 2),
                 "fe_sparse_mlookups_per_sec": _round(big_mlps, 1),
                 "fe_sparse_shape": big_shape,
+                "fe_sparse_sortperm_lbfgs_iter_ms": _round(sort_ms, 2),
+                "fe_sparse_sortperm_shape": sort_shape,
                 "re_bucket_sweep_ms": _round(re_ms, 2),
                 "re_entities": re_entities,
                 "re_shape": re_shape,

@@ -719,9 +719,212 @@ def bucketed_ell_from_scipy(mat, max_groups: int = 8,
                                     max_groups=max_groups, dtype=dtype)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class SortPermuteEllFeatures:
+    """Dual degree-bucketed ELL whose cross-order data movement is a
+    KEY-SORT instead of a gather — the sort-permutation alternative to
+    the random-access wall (docs/SCALE.md §Attacking the gather wall).
+
+    The dual-ELL iteration (``BucketedEllFeatures``) pays one
+    random-access lookup per stored slot per pass (~115-148 M lookups/s
+    flat on TPU v5e), because each pass gathers an m-sized operand in
+    the other order's arbitrary slot order. But the two slot orders are
+    FIXED at layout-build time, so moving values between them is a
+    fixed bijection — and a known permutation can be applied by
+    ``lax.sort`` over precomputed i32 keys carrying the f32 payload:
+    sequential-access sorting-network machinery, no random access of
+    the large operand at all. Per pass, the only remaining wall-rate
+    accesses are ENTITY-sized (d or n lookups), not slot-sized:
+
+    - matvec:  w[col_owner] (d-sized gather) broadcast over each
+      column's ELL run, x vals (col order, pads hold 0) -> flat [P] ->
+      sort by keys_c2r -> row order -> fixed-width row sums ->
+      un-permute ([n] gather).
+    - rmatvec: u[row_owner] (n-sized) broadcast, x vals (row order) ->
+      sort by keys_r2c -> col order -> fixed-width column sums ->
+      un-permute ([d] gather).
+
+    Win condition (measured by dev_scripts/sort_primitives.py): a
+    P~12.4M (i32, f32) key-sort in S ms makes the iteration
+    ~ 2S + ~40 ms vs the gather layout's ~187 ms at the d=2M bench
+    shape — 2x at S ~ 25 ms, break-even at S ~ 70 ms. This class is the
+    complete, parity-tested implementation either way; whether it
+    replaces the gather layout is a one-number chip decision.
+
+    Both slot spaces are padded to the same length P; the key arrays
+    are permutations of [0, P) mapping source slot -> destination slot
+    (pad slots map onto pad slots, and padded values are 0 on entry).
+    """
+
+    row_vals: Tuple[Array, ...]  # f[nr_g, w_g], row-ELL slot order
+    row_owner: Tuple[Array, ...]  # i32[nr_g] row id of each packed entity
+    row_inv: Array  # i32[n_rows] -> packed row-entity position
+    col_vals: Tuple[Array, ...]  # f[nc_g, w_g], col-ELL slot order
+    col_owner: Tuple[Array, ...]  # i32[nc_g] col id of each packed entity
+    col_inv: Array  # i32[n_features] -> packed col-entity position
+    keys_c2r: Array  # i32[P]: col-slot position -> row-slot position
+    keys_r2c: Array  # i32[P]: row-slot position -> col-slot position
+    n_rows: int
+    n_features: int
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_rows, self.n_features)
+
+    @property
+    def num_features(self) -> int:
+        return self.n_features
+
+    @property
+    def num_slots(self) -> int:
+        return (sum(v.size for v in self.row_vals)
+                + sum(v.size for v in self.col_vals))
+
+    @property
+    def sort_domain(self) -> int:
+        return self.keys_c2r.shape[0]
+
+    def _permuted(self, src_vals, src_owner, table, keys, square: bool):
+        """Expand table (entity space) over the source ELL runs, weight
+        by the source-order values, and key-sort the flat payload into
+        DESTINATION slot order. The sort's key output is the iota (keys
+        are a permutation), so position j of the payload output holds
+        the source slot whose key == j."""
+        p = keys.shape[0]
+        parts = []
+        for v, own in zip(src_vals, src_owner):
+            vv = v * v if square else v
+            parts.append((table[own][:, None] * vv).reshape(-1))
+        flat = jnp.concatenate(parts) if parts else jnp.zeros(
+            (0,), table.dtype)
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((p - flat.shape[0],), table.dtype)])
+        _, moved = jax.lax.sort((keys, flat), num_keys=1)
+        return moved
+
+    @staticmethod
+    def _reduce(moved, dst_vals_shapes, inv, dtype):
+        """Fixed-width sums over the destination side's ELL runs, then
+        the [entities]-sized inverse-permutation gather."""
+        parts, off = [], 0
+        for ng, wg in dst_vals_shapes:
+            seg = jax.lax.dynamic_slice_in_dim(moved, off, ng * wg)
+            parts.append(seg.reshape(ng, wg).sum(axis=-1))
+            off += ng * wg
+        parts.append(jnp.zeros((1,), dtype))  # degree-0 entities
+        return jnp.concatenate(parts)[inv]
+
+    def matvec(self, v: Array) -> Array:
+        moved = self._permuted(self.col_vals, self.col_owner, v,
+                               self.keys_c2r, square=False)
+        return self._reduce(moved, [a.shape for a in self.row_vals],
+                            self.row_inv, v.dtype)
+
+    def rmatvec(self, u: Array) -> Array:
+        moved = self._permuted(self.row_vals, self.row_owner, u,
+                               self.keys_r2c, square=False)
+        return self._reduce(moved, [a.shape for a in self.col_vals],
+                            self.col_inv, u.dtype)
+
+    def row_sq_matvec(self, v: Array) -> Array:
+        moved = self._permuted(self.col_vals, self.col_owner, v,
+                               self.keys_c2r, square=True)
+        return self._reduce(moved, [a.shape for a in self.row_vals],
+                            self.row_inv, v.dtype)
+
+    def sq_rmatvec(self, u: Array) -> Array:
+        moved = self._permuted(self.row_vals, self.row_owner, u,
+                               self.keys_r2c, square=True)
+        return self._reduce(moved, [a.shape for a in self.col_vals],
+                            self.col_inv, u.dtype)
+
+    def tree_flatten(self):
+        return ((self.row_vals, self.row_owner, self.row_inv,
+                 self.col_vals, self.col_owner, self.col_inv,
+                 self.keys_c2r, self.keys_r2c),
+                (self.n_rows, self.n_features))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+def sort_permute_ell_from_arrays(
+        rows, cols, vals, n_rows: int, n_cols: int, max_groups: int = 8,
+        dtype=jnp.float32) -> SortPermuteEllFeatures:
+    """Build the sort-permutation dual-ELL layout from COO triplets."""
+    rows = np.asarray(rows, np.int64)
+    cols = np.asarray(cols, np.int64)
+    vals = np.asarray(vals)
+    if n_cols > np.iinfo(np.int32).max or n_rows > np.iinfo(np.int32).max:
+        raise ValueError("sort-permute ELL uses int32 ids; shard the "
+                         "problem into column blocks past 2^31")
+    nnz = len(vals)
+
+    def pack(major, nmaj):
+        """Like bucketed_ell's pack, but returns each packed entity's
+        major id (owner) and each original nnz's flat slot position in
+        this side's packed [P_side] space instead of the minor-id
+        arrays (the sort keys replace them)."""
+        deg = np.bincount(major, minlength=nmaj)
+        order = np.argsort(major, kind="stable")
+        starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
+        groups = _degree_groups(deg, max_groups)
+        vlist, olist = [], []
+        inv = np.full(nmaj, -1, np.int64)
+        slot_of = np.empty(nnz, np.int64)
+        ent_off = slot_off = 0
+        for width, ids in groups:
+            pos = starts[ids][:, None] + np.arange(width)[None, :]
+            mask = np.arange(width)[None, :] < deg[ids][:, None]
+            sl = order[np.minimum(pos, len(order) - 1)]
+            nv = np.where(mask, vals[sl], 0).astype(vals.dtype)
+            vlist.append(jnp.asarray(nv, dtype))
+            olist.append(jnp.asarray(ids.astype(np.int32)))
+            flat_pos = (slot_off + np.arange(len(ids))[:, None] * width
+                        + np.arange(width)[None, :])
+            slot_of[sl[mask]] = flat_pos[mask]
+            inv[ids] = ent_off + np.arange(len(ids))
+            ent_off += len(ids)
+            slot_off += len(ids) * width
+        inv[inv < 0] = ent_off  # degree-0 entities -> trailing zero slot
+        return (tuple(vlist), tuple(olist),
+                jnp.asarray(inv.astype(np.int32)), slot_of, slot_off)
+
+    rv, ro, rinv, row_slot, p_rows = pack(rows, n_rows)
+    cv, co, cinv, col_slot, p_cols = pack(cols, n_cols)
+
+    # One shared sort domain: true nnz map slot<->slot; the remaining
+    # (pad / extension) positions of each side pair up in order, so the
+    # keys are full permutations of [0, P).
+    p = max(p_rows, p_cols)
+    c2r = np.full(p, -1, np.int64)
+    c2r[col_slot] = row_slot
+    free_src = np.setdiff1d(np.arange(p), col_slot, assume_unique=False)
+    free_dst = np.setdiff1d(np.arange(p), row_slot, assume_unique=False)
+    c2r[free_src] = free_dst
+    r2c = np.empty(p, np.int64)
+    r2c[c2r] = np.arange(p)
+    return SortPermuteEllFeatures(
+        row_vals=rv, row_owner=ro, row_inv=rinv,
+        col_vals=cv, col_owner=co, col_inv=cinv,
+        keys_c2r=jnp.asarray(c2r.astype(np.int32)),
+        keys_r2c=jnp.asarray(r2c.astype(np.int32)),
+        n_rows=int(n_rows), n_features=int(n_cols))
+
+
+def sort_permute_ell_from_scipy(mat, max_groups: int = 8,
+                                dtype=jnp.float32) -> SortPermuteEllFeatures:
+    coo = mat.tocoo()
+    return sort_permute_ell_from_arrays(coo.row, coo.col, coo.data,
+                                        coo.shape[0], coo.shape[1],
+                                        max_groups=max_groups, dtype=dtype)
+
+
 FeatureMatrix = Union[DenseFeatures, CSRFeatures, BlockedCSRFeatures,
                       BlockedEllFeatures, BucketedEllFeatures,
-                      KroneckerFeatures]
+                      SortPermuteEllFeatures, KroneckerFeatures]
 
 
 def csr_from_scipy(mat, n_features: int | None = None, pad_to: int | None = None,

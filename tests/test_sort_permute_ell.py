@@ -1,0 +1,123 @@
+"""SortPermuteEllFeatures: the sort-permutation sparse layout.
+
+Parity contract: identical products and solves to the gather-based
+layouts on the same matrix — the layouts differ ONLY in how values move
+between the row-ELL and col-ELL slot orders (key-sort vs slot-sized
+gather; docs/SCALE.md §Attacking the gather wall). Degree-0 rows and
+columns, skewed degree distributions, and every max_groups split must
+all survive the permutation-key construction.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import scipy.sparse as sp
+
+from tests.conftest import gold
+from photon_ml_tpu.ops import GLMObjective, LogisticLoss
+from photon_ml_tpu.ops.features import (
+    bucketed_ell_from_scipy,
+    csr_from_scipy,
+    sort_permute_ell_from_scipy,
+)
+from photon_ml_tpu.ops.glm_objective import make_batch
+from photon_ml_tpu.optimization import minimize_lbfgs
+
+
+def _skewed_matrix(rng, n=60, d=40):
+    mat = sp.random(n, d, density=0.25, random_state=7, format="lil")
+    mat[:, 5] = rng.normal(0, 1, (n, 1))  # heavy column
+    mat[7, :] = rng.normal(0, 1, (1, d))  # heavy row
+    mat[:, 3] = 0.0  # empty column
+    mat[11, :] = 0.0  # empty row
+    mat = mat.tocsr()
+    mat.eliminate_zeros()
+    return mat
+
+
+def test_sort_permute_products_match_dense(rng):
+    mat = _skewed_matrix(rng)
+    n, d = mat.shape
+    coo = mat.tocoo()
+    assert 3 not in coo.col and 11 not in coo.row  # degree-0 paths real
+    dense = mat.toarray()
+    v = rng.normal(0, 1, d)
+    u = rng.normal(0, 1, n)
+    tol = gold(1e-10, f32_floor=1e-4)
+    for max_groups in (1, 3, 8):
+        feats = sort_permute_ell_from_scipy(mat, max_groups=max_groups,
+                                            dtype=jnp.float64)
+        assert feats.shape == (n, d)
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(feats.matvec)(jnp.asarray(v))), dense @ v,
+            rtol=tol, atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(feats.rmatvec)(jnp.asarray(u))), u @ dense,
+            rtol=tol, atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(feats.row_sq_matvec(jnp.asarray(v))),
+            (dense * dense) @ v, rtol=tol, atol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(feats.sq_rmatvec(jnp.asarray(u))),
+            u @ (dense * dense), rtol=tol, atol=1e-12)
+
+
+def test_sort_keys_are_permutations(rng):
+    mat = _skewed_matrix(rng)
+    feats = sort_permute_ell_from_scipy(mat, dtype=jnp.float64)
+    p = feats.sort_domain
+    c2r = np.asarray(feats.keys_c2r)
+    r2c = np.asarray(feats.keys_r2c)
+    np.testing.assert_array_equal(np.sort(c2r), np.arange(p))
+    np.testing.assert_array_equal(np.sort(r2c), np.arange(p))
+    np.testing.assert_array_equal(r2c[c2r], np.arange(p))  # mutual inverse
+
+
+def test_sort_permute_matches_bucketed_ell_exactly(rng):
+    """Same matrix, same dtype: the two layouts are bit-comparable
+    reorderings of identical arithmetic up to summation order."""
+    mat = _skewed_matrix(rng)
+    n, d = mat.shape
+    sp_feats = sort_permute_ell_from_scipy(mat, dtype=jnp.float64)
+    be_feats = bucketed_ell_from_scipy(mat, dtype=jnp.float64)
+    v = rng.normal(0, 1, d)
+    u = rng.normal(0, 1, n)
+    np.testing.assert_allclose(
+        np.asarray(sp_feats.matvec(jnp.asarray(v))),
+        np.asarray(be_feats.matvec(jnp.asarray(v))),
+        rtol=gold(1e-12, f32_floor=1e-5))
+    np.testing.assert_allclose(
+        np.asarray(sp_feats.rmatvec(jnp.asarray(u))),
+        np.asarray(be_feats.rmatvec(jnp.asarray(u))),
+        rtol=gold(1e-12, f32_floor=1e-5))
+
+
+def test_sort_permute_solve_matches_csr(rng):
+    mat = sp.random(80, 21, density=0.3, random_state=3, format="csr")
+    mat.data[:] = rng.normal(0, 1, mat.nnz)
+    n, d = mat.shape
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    obj = GLMObjective(LogisticLoss)
+    fun = lambda w, b: obj.value(w, b, 0.3)  # noqa: E731
+
+    plain = make_batch(csr_from_scipy(mat, dtype=jnp.float64), y)
+    res1 = minimize_lbfgs(fun, jnp.zeros(d), args=(plain,), tol=1e-10)
+    spe = sort_permute_ell_from_scipy(mat, dtype=jnp.float64)
+    res2 = minimize_lbfgs(fun, jnp.zeros(d), args=(make_batch(spe, y),),
+                          tol=1e-10)
+    np.testing.assert_allclose(float(res2.value), float(res1.value),
+                               rtol=gold(1e-10))
+    np.testing.assert_allclose(np.asarray(res2.x), np.asarray(res1.x),
+                               atol=gold(1e-7, f32_floor=2e-3))
+
+
+def test_sort_permute_slot_parity_with_bucketed(rng):
+    """Slot counts agree with the gather layout (same packing), and the
+    sort domain is the larger side's slot count."""
+    mat = _skewed_matrix(rng)
+    spe = sort_permute_ell_from_scipy(mat, dtype=jnp.float64)
+    bell = bucketed_ell_from_scipy(mat, dtype=jnp.float64)
+    assert spe.num_slots == bell.num_slots
+    row_slots = sum(v.size for v in spe.row_vals)
+    col_slots = sum(v.size for v in spe.col_vals)
+    assert spe.sort_domain == max(row_slots, col_slots)
