@@ -667,6 +667,42 @@ def _degree_groups(degrees: np.ndarray, max_groups: int):
     return out
 
 
+def _degree_bucketed_pack(major, vals, nmaj: int, max_groups: int):
+    """Shared degree-bucketed ELL packing core (both the gather and the
+    sort-permute layouts build on it — the parity tests assert identical
+    slot counts, so there must be exactly ONE copy of this algorithm).
+    ELL-packs along `major`, grouped by degree; only GROUPING by major
+    is needed (slot order within an entity's run is irrelevant to the
+    fixed-width reduction), so a single-key stable sort suffices.
+    Returns (groups_iter, inv): groups_iter YIELDS one
+    (width, ids, sl, mask, nv) at a time — per-group intermediates are
+    ~100s of MB at the d=2M bench shape, so they must stream, not
+    accumulate — where sl are original nnz indices laid into the
+    [len(ids), width] grid and nv the masked values; inv is the
+    entity -> packed-position map (degree-0 entities map to the
+    trailing zero slot)."""
+    deg = np.bincount(major, minlength=nmaj)
+    order = np.argsort(major, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
+    groups = _degree_groups(deg, max_groups)
+    inv = np.full(nmaj, -1, np.int64)
+    ent_off = 0
+    for _, ids in groups:
+        inv[ids] = ent_off + np.arange(len(ids))
+        ent_off += len(ids)
+    inv[inv < 0] = ent_off  # degree-0 entities -> trailing zero slot
+
+    def gen():
+        for width, ids in groups:
+            pos = starts[ids][:, None] + np.arange(width)[None, :]
+            mask = np.arange(width)[None, :] < deg[ids][:, None]
+            sl = order[np.minimum(pos, len(order) - 1)]
+            nv = np.where(mask, vals[sl], 0).astype(vals.dtype)
+            yield width, ids, sl, mask, nv
+
+    return gen(), jnp.asarray(inv.astype(np.int32))
+
+
 def bucketed_ell_from_arrays(rows, cols, vals, n_rows: int, n_cols: int,
                              max_groups: int = 8,
                              dtype=jnp.float32) -> BucketedEllFeatures:
@@ -679,29 +715,13 @@ def bucketed_ell_from_arrays(rows, cols, vals, n_rows: int, n_cols: int,
                          "into column blocks past 2^31")
 
     def pack(major, minor, nmaj):
-        """ELL-pack along `major`, grouped by degree. Returns
-        (vals_list, idx_list, inv). Only GROUPING by major is needed
-        (slot order within an entity's run is irrelevant to the
-        fixed-width reduction), so a single-key stable sort suffices."""
-        deg = np.bincount(major, minlength=nmaj)
-        order = np.argsort(major, kind="stable")
-        starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
-        groups = _degree_groups(deg, max_groups)
+        packed, inv = _degree_bucketed_pack(major, vals, nmaj, max_groups)
         vlist, ilist = [], []
-        inv = np.full(nmaj, -1, np.int64)
-        offset = 0
-        for width, ids in groups:
-            pos = starts[ids][:, None] + np.arange(width)[None, :]
-            mask = np.arange(width)[None, :] < deg[ids][:, None]
-            sl = order[np.minimum(pos, len(order) - 1)]
-            nv = np.where(mask, vals[sl], 0).astype(vals.dtype)
-            ni = np.where(mask, minor[sl], 0).astype(np.int32)
+        for _, _, sl, mask, nv in packed:  # single streaming pass
             vlist.append(jnp.asarray(nv, dtype))
-            ilist.append(jnp.asarray(ni))
-            inv[ids] = offset + np.arange(len(ids))
-            offset += len(ids)
-        inv[inv < 0] = offset  # degree-0 entities -> trailing zero slot
-        return tuple(vlist), tuple(ilist), jnp.asarray(inv.astype(np.int32))
+            ilist.append(
+                jnp.asarray(np.where(mask, minor[sl], 0).astype(np.int32)))
+        return tuple(vlist), tuple(ilist), inv
 
     rv, rc, rinv = pack(rows, cols, n_rows)
     cv, cr, cinv = pack(cols, rows, n_cols)
@@ -863,34 +883,22 @@ def sort_permute_ell_from_arrays(
     nnz = len(vals)
 
     def pack(major, nmaj):
-        """Like bucketed_ell's pack, but returns each packed entity's
-        major id (owner) and each original nnz's flat slot position in
-        this side's packed [P_side] space instead of the minor-id
-        arrays (the sort keys replace them)."""
-        deg = np.bincount(major, minlength=nmaj)
-        order = np.argsort(major, kind="stable")
-        starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
-        groups = _degree_groups(deg, max_groups)
+        """Like bucketed_ell's pack (same _degree_bucketed_pack core), but returns
+        each packed entity's major id (owner) and each original nnz's
+        flat slot position in this side's packed [P_side] space instead
+        of the minor-id arrays (the sort keys replace them)."""
+        packed, inv = _degree_bucketed_pack(major, vals, nmaj, max_groups)
         vlist, olist = [], []
-        inv = np.full(nmaj, -1, np.int64)
         slot_of = np.empty(nnz, np.int64)
-        ent_off = slot_off = 0
-        for width, ids in groups:
-            pos = starts[ids][:, None] + np.arange(width)[None, :]
-            mask = np.arange(width)[None, :] < deg[ids][:, None]
-            sl = order[np.minimum(pos, len(order) - 1)]
-            nv = np.where(mask, vals[sl], 0).astype(vals.dtype)
+        slot_off = 0
+        for width, ids, sl, mask, nv in packed:
             vlist.append(jnp.asarray(nv, dtype))
             olist.append(jnp.asarray(ids.astype(np.int32)))
             flat_pos = (slot_off + np.arange(len(ids))[:, None] * width
                         + np.arange(width)[None, :])
             slot_of[sl[mask]] = flat_pos[mask]
-            inv[ids] = ent_off + np.arange(len(ids))
-            ent_off += len(ids)
             slot_off += len(ids) * width
-        inv[inv < 0] = ent_off  # degree-0 entities -> trailing zero slot
-        return (tuple(vlist), tuple(olist),
-                jnp.asarray(inv.astype(np.int32)), slot_of, slot_off)
+        return tuple(vlist), tuple(olist), inv, slot_of, slot_off
 
     rv, ro, rinv, row_slot, p_rows = pack(rows, n_rows)
     cv, co, cinv, col_slot, p_cols = pack(cols, n_cols)
@@ -899,6 +907,11 @@ def sort_permute_ell_from_arrays(
     # (pad / extension) positions of each side pair up in order, so the
     # keys are full permutations of [0, P).
     p = max(p_rows, p_cols)
+    if p > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"sort-permute ELL keys are int32 but the padded slot space "
+            f"has {p} positions (> 2^31-1); shard the problem into "
+            f"column blocks first (parallel/distributed.py)")
     c2r = np.full(p, -1, np.int64)
     c2r[col_slot] = row_slot
     free_src = np.setdiff1d(np.arange(p), col_slot, assume_unique=False)

@@ -52,3 +52,48 @@ def test_prep_residue_skewed_distribution_pads_chunks():
     flat = packed.reshape(-1)
     got = flat[slot] * 128 + (slot % 128)
     np.testing.assert_array_equal(got, idx)
+
+
+def test_prep_blocks_arbitrary_width_is_exact_permutation():
+    """The block-width sweep (--sweep) reuses _prep_blocks at non-default
+    widths; the packing must stay an exact permutation at every width."""
+    rng = np.random.default_rng(11)
+    d = 3 * 512 + 100  # ragged final block at width 512
+    m = 3000
+    idx = rng.integers(0, d, m).astype(np.int32)
+    for block in (256, 512, 1024):
+        local, mask, slot = _prep_blocks(idx, d, block=block)
+        kb, e = local.shape
+        assert kb == -(-d // block)
+        assert mask.sum() == m
+        flat_local = local.reshape(-1)
+        owner_of_slot = np.repeat(np.arange(kb), e)
+        got = owner_of_slot[slot] * block + flat_local[slot]
+        np.testing.assert_array_equal(got, idx)
+
+
+def test_variant_args_rolls_named_arrays_together():
+    """_time_distinct's per-rep inputs: arrays named in roll_axes shift
+    by the EXPECTED variant shift — the same amount for both (keeping
+    index/mask pairs aligned) — and unnamed arrays are returned
+    untouched (shared tables). The expected shift is computed from
+    _NONCE, not recovered from the output, so a no-op regression (which
+    would silently re-open the same-args caching hole) fails the
+    test."""
+    import jax.numpy as jnp
+
+    from dev_scripts.gather_experiments import _NONCE, _variant_args
+
+    a = jnp.arange(12).reshape(3, 4)
+    b = jnp.arange(12, 24).reshape(3, 4)
+    w = jnp.arange(5)
+    va, vb, vw = _variant_args((a, b, w), {0: 1, 1: 1}, 2)
+    assert vw is w
+    shift = (1009 + _NONCE) * 2
+    np.testing.assert_array_equal(np.asarray(va),
+                                  np.roll(np.asarray(a), shift, axis=1))
+    np.testing.assert_array_equal(np.asarray(vb),
+                                  np.roll(np.asarray(b), shift, axis=1))
+    # Consecutive variant indices must produce DISTINCT dispatch bytes:
+    # the raw shift difference (1009 + _NONCE) is never zero.
+    assert (1009 + _NONCE) > 0
