@@ -74,6 +74,21 @@ def main() -> int:
     ]
     selected = sys.argv[1:]
     failures = []
+
+    def run_group(checks):
+        """Shared check runner: time each (name, thunk), print one line,
+        record failures (exit-code accounting happens at the end)."""
+        for name, thunk in checks:
+            t0 = time.perf_counter()
+            try:
+                thunk()
+                print(f"{name:28s}: MOSAIC COMPILE OK "
+                      f"({time.perf_counter() - t0:.1f}s)", flush=True)
+            except Exception as ex:  # noqa: BLE001
+                failures.append(name)
+                first = str(ex).strip().splitlines()
+                print(f"{name:28s}: FAILED — "
+                      f"{first[0][:160] if first else ex}", flush=True)
     for name, loss, mode, kw in variants:
         if selected and not any(s in name for s in selected):
             continue
@@ -229,30 +244,40 @@ def main() -> int:
         yield "gather:residue(d=2M is capped)", residue_big_must_fail
 
     if not selected or any(s in "gather" for s in selected):
-        for name, thunk in gather_checks():
-            t0 = time.perf_counter()
-            try:
-                thunk()
-                print(f"{name:28s}: MOSAIC COMPILE OK "
-                      f"({time.perf_counter() - t0:.1f}s)", flush=True)
-            except Exception as ex:  # noqa: BLE001
-                failures.append(name)
-                first = str(ex).strip().splitlines()
-                print(f"{name:28s}: FAILED — "
-                      f"{first[0][:160] if first else ex}", flush=True)
+        run_group(gather_checks())
+
+    # Sort-permutation sparse layout (docs/SCALE.md §Attacking the
+    # gather wall): both products compile for v5e at the d=2M bench
+    # geometry — a ~12M-element (i32, f32) lax.sort per pass plus the
+    # broadcast expansions and fixed-width reductions. Compile certainty
+    # here; the integrate-or-close decision needs the chip sort RATE
+    # (dev_scripts/sort_primitives.py).
+    def sortperm_checks():
+        from photon_ml_tpu.ops.features import SortPermuteEllFeatures
+
+        n_r, d_c, w_r = 250_000, 2_000_000, 48
+        col_groups = [(1_500_000, 7), (500_000, 4)]
+        p = max(n_r * w_r, sum(ng * wg for ng, wg in col_groups))
+        feats = SortPermuteEllFeatures(
+            row_vals=(arg((n_r, w_r)),),
+            row_owner=(arg((n_r,), jnp.int32),),
+            row_inv=arg((n_r,), jnp.int32),
+            col_vals=tuple(arg((ng, wg)) for ng, wg in col_groups),
+            col_owner=tuple(arg((ng,), jnp.int32) for ng, _ in col_groups),
+            col_inv=arg((d_c,), jnp.int32),
+            keys_c2r=arg((p,), jnp.int32),
+            keys_r2c=arg((p,), jnp.int32),
+            n_rows=n_r, n_features=d_c)
+        yield "sortperm:matvec(d=2M)", lambda: jax.jit(
+            lambda f, v: f.matvec(v)).lower(feats, arg((d_c,))).compile()
+        yield "sortperm:rmatvec(d=2M)", lambda: jax.jit(
+            lambda f, u: f.rmatvec(u)).lower(feats, arg((n_r,))).compile()
+
+    if not selected or any(s in "sortperm" for s in selected):
+        run_group(sortperm_checks())
 
     if not selected or any(s in "sharded" for s in selected):
-        for name, thunk in shard_checks():
-            t0 = time.perf_counter()
-            try:
-                thunk()
-                print(f"{name:28s}: MOSAIC COMPILE OK "
-                      f"({time.perf_counter() - t0:.1f}s)", flush=True)
-            except Exception as ex:  # noqa: BLE001
-                failures.append(name)
-                first = str(ex).strip().splitlines()
-                print(f"{name:28s}: FAILED — "
-                      f"{first[0][:160] if first else ex}", flush=True)
+        run_group(shard_checks())
 
     if failures:
         print(f"FAILED VARIANTS: {failures}")

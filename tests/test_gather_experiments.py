@@ -72,28 +72,31 @@ def test_prep_blocks_arbitrary_width_is_exact_permutation():
         np.testing.assert_array_equal(got, idx)
 
 
-def test_variant_args_rolls_named_arrays_together():
+def test_variant_args_rolls_named_arrays_together(monkeypatch):
     """_time_distinct's per-rep inputs: arrays named in roll_axes shift
     by the EXPECTED variant shift — the same amount for both (keeping
     index/mask pairs aligned) — and unnamed arrays are returned
-    untouched (shared tables). The expected shift is computed from
-    _NONCE, not recovered from the output, so a no-op regression (which
-    would silently re-open the same-args caching hole) fails the
-    test."""
+    untouched (shared tables). The nonce is pinned so the expected roll
+    is provably non-identity regardless of test-process pid: a no-op
+    regression of _variant_args (which would silently re-open the
+    same-args caching hole) fails the equality asserts."""
     import jax.numpy as jnp
 
-    from dev_scripts.gather_experiments import _NONCE, _variant_args
+    import dev_scripts.gather_experiments as ge
 
+    monkeypatch.setattr(ge, "_NONCE", 4)  # shift (1009+4)*2 % 4 == 2
     a = jnp.arange(12).reshape(3, 4)
     b = jnp.arange(12, 24).reshape(3, 4)
     w = jnp.arange(5)
-    va, vb, vw = _variant_args((a, b, w), {0: 1, 1: 1}, 2)
+    va, vb, vw = ge._variant_args((a, b, w), {0: 1, 1: 1}, 2)
     assert vw is w
-    shift = (1009 + _NONCE) * 2
+    shift = (1009 + 4) * 2
+    assert shift % a.shape[1] != 0  # the roll below is NOT the identity
+    assert not np.array_equal(np.asarray(va), np.asarray(a))
     np.testing.assert_array_equal(np.asarray(va),
                                   np.roll(np.asarray(a), shift, axis=1))
     np.testing.assert_array_equal(np.asarray(vb),
                                   np.roll(np.asarray(b), shift, axis=1))
-    # Consecutive variant indices must produce DISTINCT dispatch bytes:
-    # the raw shift difference (1009 + _NONCE) is never zero.
-    assert (1009 + _NONCE) > 0
+    # The real per-process nonce keeps cross-process dispatches distinct.
+    monkeypatch.undo()
+    assert 1 <= ge._NONCE <= 997
