@@ -493,16 +493,18 @@ def scale_re_100k_entities():
               for i, (e, r) in enumerate(buckets)]
     coefs0 = [jnp.zeros((e, d), jnp.float32) for e, _ in buckets]
 
-    def sweep():
-        return [_solve_block(obj, cfg, b, None, c0)
+    def sweep(rep=0):
+        # rep-distinct warm starts: no dispatch repeats byte-identically
+        # (docs/SCALE.md §methodology on relay-side result caching)
+        return [_solve_block(obj, cfg, b, None, c0 + rep * 1e-7)
                 for b, c0 in zip(blocks, coefs0)]
 
-    out = sweep()
+    out = sweep(0)
     _sync(out[-1].x)
     reps = 3
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = sweep()
+    for k in range(reps):
+        out = sweep(k + 1)
     _sync(out[-1].x)
     ms = (time.perf_counter() - t0) / reps * 1e3
     shape = (" + ".join(f"{e/1000:g}k x {r}" if e >= 1000 else f"{e} x {r}"
@@ -543,32 +545,57 @@ def game_full_phase_ms():
     x_flat, y_flat, off_flat, w_flat = _flatten_factored_static(
         blocks, [None] * len(blocks), d)
 
-    def latent():
+    def latent(rep=0):
         return [_solve_factored_block(fre._objective, fre.config, b, B,
-                                      None, g0, d)
+                                      None, g0 + rep * 1e-7, d)
                 for b, g0 in zip(blocks, gammas)]
 
-    def timed(fn, reps=3):
-        out = fn()
+    def timed(fn, lo=2, hi=8):
+        """Marginal ms per phase execution: (t(hi reps) - t(lo reps)) /
+        (hi - lo). A phase is a SMALL dispatch, so an absolute per-call
+        time is dominated by the remote-dispatch round trip (~10-70 ms
+        — exactly what made the round-5 chip phase numbers sum to the
+        whole iteration); the marginal difference strips it. Each rep
+        perturbs an input so no dispatch repeats byte-identically
+        (docs/SCALE.md §methodology on relay-side result caching)."""
+        out = fn(0)
         _sync(out[-1] if isinstance(out, list) else out)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = fn()
-        _sync(out[-1] if isinstance(out, list) else out)
-        return (time.perf_counter() - t0) / reps * 1e3, out
 
-    latent_ms, results = timed(latent)
+        def run(reps, rep0):
+            t0 = time.perf_counter()
+            for k in range(reps):
+                o = fn(rep0 + k)
+            _sync(o[-1] if isinstance(o, list) else o)
+            return time.perf_counter() - t0
+
+        t_lo = run(lo, 1)
+        t_hi = run(hi, 100)
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / (hi - lo) * 1e3, True, out
+        # noise floor: amortized fallback — still RTT-inclusive
+        return t_hi / hi * 1e3, False, out
+
+    def label(ok):
+        return ("marginal over rep counts (dispatch-RTT-free)" if ok
+                else "amortized (reps did not separate; RTT-inclusive)")
+
+    latent_ms, latent_ok, results = timed(latent)
     gammas2 = [r.x for r in results]
     batch = GLMBatch(
         KroneckerFeatures(x_flat, _flatten_gammas(blocks, gammas2)),
         y_flat, off_flat, w_flat)
-    refit_ms, _ = timed(lambda: _solve_latent_matrix(
-        fre._objective, fre.latent_config, batch, B.reshape(-1)))
-    rescore_ms, _ = timed(
-        lambda: fre.pure_score(sd, (tuple(gammas2), B)))
+    refit_ms, refit_ok, _ = timed(lambda rep=0: _solve_latent_matrix(
+        fre._objective, fre.latent_config, batch,
+        B.reshape(-1) + rep * 1e-7))
+    rescore_ms, rescore_ok, _ = timed(
+        lambda rep=0: fre.pure_score(
+            sd, (tuple(gammas2), B + rep * 1e-7)))
     return {"latent_solves_ms": round(latent_ms, 2),
+            "latent_methodology": label(latent_ok),
             "b_refit_ms": round(refit_ms, 2),
+            "b_refit_methodology": label(refit_ok),
             "rescore_ms": round(rescore_ms, 2),
+            "rescore_methodology": label(rescore_ok),
             "n_entities": sum(b.num_entities for b in blocks),
             "note": "one MF alternation = latent + refit (+ rescore once "
                     "per coordinate update); reference alternation "
@@ -668,17 +695,35 @@ def scoring_rows_per_sec():
     from photon_ml_tpu.models.device_scoring import DeviceGameScorer
     from photon_ml_tpu.types import TaskType
 
+    import jax
+    import jax.numpy as jnp
+
     data = build_problem()
     cd = CoordinateDescent(build_coords(data, full_game=True),
                            TaskType.LOGISTIC_REGRESSION)
     model = cd.run(num_iterations=1).model
     scorer = DeviceGameScorer(model, data)
-    out = scorer.score(model)
+
+    base_params = scorer._params_of(model)  # hoisted: host-side work
+    sdata = tuple(scorer._sdata)
+
+    def score(rep=0):
+        # rep-distinct coefficient perturbations so no scoring dispatch
+        # repeats byte-identically (docs/SCALE.md §methodology on
+        # relay-side result caching); 1e-7 shifts don't change the work,
+        # and the per-rep cost is one tiny async device add per leaf.
+        params = jax.tree.map(
+            lambda a: a + rep * 1e-7
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            base_params)
+        return scorer._fn(sdata, params)
+
+    out = score(0)
     _sync(out)
     reps = 10
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = scorer.score(model)
+    for k in range(reps):
+        out = score(k + 1)
     _sync(out)
     dt = (time.perf_counter() - t0) / reps
     return (data.num_rows / dt,

@@ -89,21 +89,17 @@ def main() -> int:
                 first = str(ex).strip().splitlines()
                 print(f"{name:28s}: FAILED — "
                       f"{first[0][:160] if first else ex}", flush=True)
-    for name, loss, mode, kw in variants:
-        if selected and not any(s in name for s in selected):
-            continue
-        fn = functools.partial(pallas_entity_lbfgs, loss, max_iter=15,
-                               tol=1e-6, mode=mode)
-        t0 = time.perf_counter()
-        try:
-            jax.jit(fn).lower(*base, **kw).compile()
-            print(f"{name:18s}: MOSAIC COMPILE OK "
-                  f"({time.perf_counter() - t0:.1f}s)", flush=True)
-        except Exception as ex:  # noqa: BLE001
-            failures.append(name)
-            first = str(ex).strip().splitlines()
-            print(f"{name:18s}: FAILED — {first[0][:160] if first else ex}",
-                  flush=True)
+    def variant_checks():
+        for name, loss, mode, kw in variants:
+            if selected and not any(s in name for s in selected):
+                continue
+            fn = functools.partial(pallas_entity_lbfgs, loss, max_iter=15,
+                                   tol=1e-6, mode=mode)
+            yield name, functools.partial(
+                lambda fn_, kw_: jax.jit(fn_).lower(*base, **kw_).compile(),
+                fn, kw)
+
+    run_group(variant_checks())
     # Multi-chip compiles: the SAME paths the virtual-CPU dryrun executes,
     # but compiled for a real v5e 2x2 slice — XLA lowers the sharding
     # annotations to actual ICI collectives, something no CPU mesh can
