@@ -704,8 +704,7 @@ def scoring_rows_per_sec():
     model = cd.run(num_iterations=1).model
     scorer = DeviceGameScorer(model, data)
 
-    base_params = scorer._params_of(model)  # hoisted: host-side work
-    sdata = tuple(scorer._sdata)
+    base_params = scorer.params_of(model)  # hoisted: host-side work
 
     def score(rep=0):
         # rep-distinct coefficient perturbations so no scoring dispatch
@@ -716,7 +715,7 @@ def scoring_rows_per_sec():
             lambda a: a + rep * 1e-7
             if jnp.issubdtype(a.dtype, jnp.floating) else a,
             base_params)
-        return scorer._fn(sdata, params)
+        return scorer.score_with_params(params)
 
     out = score(0)
     _sync(out)

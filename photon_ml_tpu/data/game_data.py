@@ -115,11 +115,15 @@ class GameDataset:
         self, shard_id: str, dtype=jnp.float32,
         extra_offsets: Optional[np.ndarray] = None,
         dense_threshold: float = DENSE_DENSITY_THRESHOLD,
+        sparse_layout: str = "csr",
     ) -> GLMBatch:
         """Materialize one feature shard as a device GLMBatch
-        (the analog of FixedEffectDataSet, ml/data/FixedEffectDataSet.scala:29-103)."""
+        (the analog of FixedEffectDataSet, ml/data/FixedEffectDataSet.scala:29-103).
+        ``sparse_layout`` picks the below-threshold layout ("csr" |
+        "bucketed_ell" | "sort_permute_ell" — see features_to_device)."""
         mat = self.feature_shards[shard_id]
-        feats = features_to_device(mat, dtype, dense_threshold)
+        feats = features_to_device(mat, dtype, dense_threshold,
+                                   sparse_layout=sparse_layout)
         off = self.offsets if extra_offsets is None else \
             self.offsets + extra_offsets
         return GLMBatch(

@@ -50,12 +50,15 @@ class TrainedGLM:
 def device_batch(features, labels, offsets=None, weights=None,
                  dtype=jnp.float32,
                  dense_threshold: float = DENSE_DENSITY_THRESHOLD,
-                 storage_dtype=None):
-    """Host arrays -> device GLMBatch, choosing dense vs CSR layout.
+                 storage_dtype=None, sparse_layout: str = "csr"):
+    """Host arrays -> device GLMBatch, choosing dense vs sparse layout.
     ``storage_dtype=jnp.bfloat16`` halves dense feature HBM traffic
-    (f32 accumulation — see DenseFeatures)."""
+    (f32 accumulation — see DenseFeatures); ``sparse_layout`` picks the
+    below-threshold layout ("csr" | "bucketed_ell" |
+    "sort_permute_ell" — see features_to_device)."""
     feats = features_to_device(features, dtype, dense_threshold,
-                               storage_dtype=storage_dtype)
+                               storage_dtype=storage_dtype,
+                               sparse_layout=sparse_layout)
     return make_batch(
         feats, jnp.asarray(labels, dtype),
         None if offsets is None else jnp.asarray(offsets, dtype),

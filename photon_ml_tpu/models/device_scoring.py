@@ -182,4 +182,14 @@ class DeviceGameScorer:
     def score(self, model: GameModel) -> Array:
         """Additive score over all sub-models: one jitted dispatch, device
         result (transfer with np.asarray only when host values are needed)."""
-        return self._fn(tuple(self._sdata), self._params_of(model))
+        return self.score_with_params(self.params_of(model))
+
+    def params_of(self, model: GameModel):
+        """Extract the device params pytree score_with_params consumes —
+        public so callers timing repeated scores can hoist the (host-side)
+        extraction and vary the params per call."""
+        return self._params_of(model)
+
+    def score_with_params(self, params) -> Array:
+        """Score from a pre-extracted params pytree (see params_of)."""
+        return self._fn(tuple(self._sdata), params)

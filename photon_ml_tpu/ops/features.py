@@ -971,8 +971,9 @@ DENSE_DENSITY_THRESHOLD = 0.2
 
 def features_to_device(mat, dtype=jnp.float32,
                        dense_threshold: float = DENSE_DENSITY_THRESHOLD,
-                       storage_dtype=None) -> FeatureMatrix:
-    """Host feature matrix -> device layout, choosing dense vs CSR by
+                       storage_dtype=None,
+                       sparse_layout: str = "csr") -> FeatureMatrix:
+    """Host feature matrix -> device layout, choosing dense vs sparse by
     density. The single chooser shared by the GLM and GAME ingest paths.
 
     ``storage_dtype=jnp.bfloat16`` stores DENSE features at half width
@@ -980,14 +981,23 @@ def features_to_device(mat, dtype=jnp.float32,
     bandwidth-bound fixed-effect iteration — see DenseFeatures). Sparse
     layouts ignore it (their cost is lookup-count-, not byte-, bound).
 
-    For LARGE sparse problems (nnz beyond a few million) on TPU, build
-    ``bucketed_ell_from_scipy`` explicitly instead: CSR's transpose
-    product is scatter-bound, while degree-bucketed dual-ELL is
-    gather-only with near-nnz slot counts at ~2x the memory — see
-    docs/SCALE.md. Use ``blocked_ell_from_scipy`` for the mesh-sharded
+    ``sparse_layout`` picks the layout used below the density
+    threshold: ``"csr"`` (default — fine for small/medium nnz),
+    ``"bucketed_ell"`` (degree-bucketed dual-ELL: gather-only products,
+    near-nnz slot counts at ~2x the memory — the right choice past a
+    few million nnz on TPU, where CSR's transpose product is
+    scatter-bound), or ``"sort_permute_ell"`` (cross-order movement as
+    one key-sort per pass; chip-gated alternative, see docs/SCALE.md).
+    Use ``blocked_ell_from_scipy`` directly for the mesh-sharded
     (column-blocked) variant."""
     import scipy.sparse as sp
 
+    if sparse_layout not in ("csr", "bucketed_ell", "sort_permute_ell"):
+        # validate up front: a typo'd name must fail loudly even when
+        # the density branch would never consult it (dense input)
+        raise ValueError(
+            f"unknown sparse_layout {sparse_layout!r}: expected "
+            "'csr', 'bucketed_ell', or 'sort_permute_ell'")
     dense_dt = storage_dtype if storage_dtype is not None else dtype
     if sp.issparse(mat):
         density = mat.nnz / max(1, mat.shape[0] * mat.shape[1])
@@ -1003,7 +1013,11 @@ def features_to_device(mat, dtype=jnp.float32,
             warnings.warn(
                 f"storage_dtype={storage_dtype} ignored: data density is "
                 f"below the dense threshold ({dense_threshold:.2f}), which "
-                "selects the CSR layout (sparse layouts are "
+                "selects a sparse layout (sparse layouts are "
                 "lookup-count-bound, not byte-bound)", stacklevel=2)
+        if sparse_layout == "bucketed_ell":
+            return bucketed_ell_from_scipy(mat, dtype=dtype)
+        if sparse_layout == "sort_permute_ell":
+            return sort_permute_ell_from_scipy(mat, dtype=dtype)
         return csr_from_scipy(mat, dtype=dtype)
     return DenseFeatures(jnp.asarray(np.asarray(mat), dense_dt))

@@ -121,3 +121,31 @@ def test_sort_permute_slot_parity_with_bucketed(rng):
     row_slots = sum(v.size for v in spe.row_vals)
     col_slots = sum(v.size for v in spe.col_vals)
     assert spe.sort_domain == max(row_slots, col_slots)
+
+
+def test_features_to_device_sparse_layout_option(rng):
+    """The shared ingest chooser exposes every sparse layout by name."""
+    import pytest
+
+    from photon_ml_tpu.ops.features import (
+        BucketedEllFeatures,
+        CSRFeatures,
+        SortPermuteEllFeatures,
+        features_to_device,
+    )
+
+    mat = sp.random(50, 40, density=0.05, random_state=2, format="csr")
+    mat.data[:] = rng.normal(0, 1, mat.nnz)
+    dense = mat.toarray()
+    v = rng.normal(0, 1, 40)
+    for layout, cls in [("csr", CSRFeatures),
+                        ("bucketed_ell", BucketedEllFeatures),
+                        ("sort_permute_ell", SortPermuteEllFeatures)]:
+        feats = features_to_device(mat, dtype=jnp.float64,
+                                   sparse_layout=layout)
+        assert isinstance(feats, cls)
+        np.testing.assert_allclose(
+            np.asarray(feats.matvec(jnp.asarray(v))), dense @ v,
+            rtol=gold(1e-10, f32_floor=1e-4), atol=1e-12)
+    with pytest.raises(ValueError, match="unknown sparse_layout"):
+        features_to_device(mat, sparse_layout="nope")
