@@ -602,38 +602,21 @@ def game_full_phase_ms():
                     "FactoredRandomEffectCoordinate.scala:99-165"}
 
 
-def ingest_rows_per_sec():
-    """Host Avro→CSR ingest throughput (VERDICT r4 item 7): the reference
-    parallelizes decode across Spark executors (AvroDataReader.scala:86-214);
-    here ONE host feeds the chip, so rows/sec of the native C block decoder
-    (native/_avro_native.c decode_training_block) vs the pure-python
-    record-at-a-time path decides when ingest bottlenecks end-to-end
-    wallclock (crossover analysis: docs/SCALE.md §Host ingest)."""
-    import shutil
-    import tempfile
-
-    from photon_ml_tpu.data.avro_reader import (
-        build_index_map,
-        read_labeled_points,
-    )
-    from photon_ml_tpu.data.fast_ingest import fast_ingest
-    from photon_ml_tpu.io import schemas
-    from photon_ml_tpu.io.avro_codec import write_container
-
-    n, py_n, d, per_row = ((60_000, 8_000, 5_000, 20)
-                           if SHAPE_SCALE == "full"
-                           else (8_000, 2_000, 1_000, 20))
-    rng = np.random.default_rng(11)
-    # Distinct columns per row (slot j draws from residue class j mod
-    # per_row) — duplicate (name, term) features are rejected at ingest,
-    # matching the reference (AvroDataReader.scala:306-311).
-    cols = (rng.integers(0, d // per_row, (n, per_row)) * per_row
-            + np.arange(per_row))
-    vals = rng.normal(0, 1, (n, per_row))
-    labels = (rng.random(n) < 0.5).astype(float)
-
-    def records(k):
-        for i in range(k):
+def _ingest_records(k, d, per_row, seed=11):
+    """Streaming TrainingExampleAvro record generator (chunked rng so the
+    2M-row shape never holds the full column/value arrays). Distinct
+    columns per row (slot j draws from residue class j mod per_row) —
+    duplicate (name, term) features are rejected at ingest, matching the
+    reference (AvroDataReader.scala:306-311)."""
+    rng = np.random.default_rng(seed)
+    made = 0
+    while made < k:
+        m = min(50_000, k - made)
+        cols = (rng.integers(0, d // per_row, (m, per_row)) * per_row
+                + np.arange(per_row))
+        vals = rng.normal(0, 1, (m, per_row))
+        labels = (rng.random(m) < 0.5).astype(float)
+        for i in range(m):
             yield {
                 "uid": None,
                 "label": labels[i],
@@ -641,24 +624,115 @@ def ingest_rows_per_sec():
                     {"name": f"f{c}", "term": None, "value": float(v)}
                     for c, v in zip(cols[i], vals[i])],
                 "weight": None, "offset": None,
-                "metadataMap": {"userId": f"u{i % 97}"},
+                "metadataMap": {"userId": f"u{(made + i) % 97}"},
             }
+        made += m
+
+
+def ingest_rows_per_sec():
+    """Host Avro→CSR ingest throughput (VERDICT r4 item 7 + r5 item 5):
+    the reference parallelizes decode across Spark executors
+    (AvroDataReader.scala:86-214); here the multi-process sharded pipeline
+    (data/parallel_ingest.py — block-range shards, one C decoder per
+    worker, shared-memory transport) is the single-host analog. Reports
+    the worker-scaling curve {1, 2, 4, 8} at the 2M-row shape (full runs),
+    the pure-python baseline, decode+H2D overlap throughput, and the
+    updated ingest-vs-solve crossover (docs/SCALE.md §Host ingest).
+
+    The generated container file is cached across runs (~3.5 min to encode
+    2M rows with the pure-python writer on one core); override rows with
+    PHOTON_BENCH_INGEST_ROWS, cache dir with PHOTON_BENCH_INGEST_CACHE."""
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.data.avro_reader import (
+        build_index_map,
+        read_labeled_points,
+    )
+    from photon_ml_tpu.data.device_feed import OverlappedUploader
+    from photon_ml_tpu.data.fast_ingest import fast_ingest
+    from photon_ml_tpu.data.parallel_ingest import parallel_fast_ingest
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+
+    full = SHAPE_SCALE == "full"
+    n = int(os.environ.get("PHOTON_BENCH_INGEST_ROWS") or
+            (2_000_000 if full else 60_000))
+    py_n, d, per_row = (8_000 if full else 2_000), 5_000, 20
+    worker_counts = (1, 2, 4, 8)
+    try:
+        cpu_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cpu_cores = os.cpu_count() or 1
+
+    cache_dir = (os.environ.get("PHOTON_BENCH_INGEST_CACHE")
+                 or os.path.expanduser("~/.cache/photon_ingest_bench"))
+    os.makedirs(cache_dir, exist_ok=True)
+    # v1 = _ingest_records generator version: bump it whenever the record
+    # shape/seed/distribution changes or stale cached bytes get measured.
+    big = os.path.join(cache_dir, f"ingest_v1_{n}x{per_row}_d{d}.avro")
+    if not os.path.exists(big):
+        tmp_big = f"{big}.{os.getpid()}.tmp"  # per-process: no write race
+        try:
+            write_container(tmp_big, schemas.TRAINING_EXAMPLE,
+                            _ingest_records(n, d, per_row))
+            os.replace(tmp_big, big)
+        finally:
+            if os.path.exists(tmp_big):
+                os.unlink(tmp_big)
 
     tmp = tempfile.mkdtemp(prefix="photon_bench_ingest_")
     try:
-        big = os.path.join(tmp, "big.avro")
         small = os.path.join(tmp, "small.avro")
-        write_container(big, schemas.TRAINING_EXAMPLE, records(n))
-        write_container(small, schemas.TRAINING_EXAMPLE, records(py_n))
+        write_container(small, schemas.TRAINING_EXAMPLE,
+                        _ingest_records(py_n, d, per_row))
         imap = build_index_map(big)
+        icepts = {"global": imap.intercept_index}
 
+        rates = {}
+        for w in worker_counts:
+            t0 = time.perf_counter()
+            fast = fast_ingest([big], {"global": imap}, icepts,
+                               id_types=["userId"], workers=w)
+            dt = time.perf_counter() - t0
+            if fast is None:
+                raise RuntimeError("native fast path unavailable")
+            rates[str(w)] = round(n / dt)
+        best_w = max(rates, key=lambda k: rates[k])
+
+        # Decode overlapped with chunked H2D of the label/offset/weight
+        # columns (one double-buffered uploader per column, fed per
+        # completed shard) — certifies the full decode->device pipeline
+        # end to end.
+        ups = [OverlappedUploader() for _ in range(3)]
+
+        def feed(seq, lb, ob, wb):
+            for up, col in zip(ups, (lb, ob, wb)):
+                up.submit(col)
+
+        # column_consumer only exists on the parallel path, so this runs
+        # at >= 2 workers; the honest overhead baseline is the SAME
+        # worker count's decode-only rate, not best_workers.
+        h2d_workers = max(2, int(best_w))
         t0 = time.perf_counter()
-        fast = fast_ingest([big], {"global": imap},
-                           {"global": imap.intercept_index},
-                           id_types=["userId"])
-        c_dt = time.perf_counter() - t0
-        if fast is None:
-            raise RuntimeError("native fast path unavailable")
+        res = parallel_fast_ingest(
+            [big], {"global": imap}, icepts, id_types=["userId"],
+            workers=h2d_workers, column_consumer=feed)
+        devs = [up.collect() for up in ups]
+        if devs[0] is not None:
+            import jax
+
+            jax.block_until_ready(devs)
+        h2d_dt = time.perf_counter() - t0
+        h2d = None
+        if res is not None:
+            h2d = {
+                "rows_per_sec": round(n / h2d_dt),
+                "workers": h2d_workers,
+                "decode_only_same_workers_rows_per_sec":
+                    rates[str(h2d_workers)],
+                "columns": "labels+offsets+weights",
+            }
 
         # Force the pure-python decoder (smaller file, same layout).
         import photon_ml_tpu.native as nat
@@ -673,15 +747,54 @@ def ingest_rows_per_sec():
             nat._loaded, nat._module = saved
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
-    c_rps, py_rps = n / c_dt, py_n / py_dt
+
+    c_rps, py_rps = rates["1"], py_n / py_dt
+    best_rps = rates[best_w]
+    # Crossover vs solve: rows ingestible (best path) in the time of a
+    # 100-iteration GLMix fit at the frozen chip rate. Solve per-iter
+    # time scales ~linearly with rows past the bench shape, so past the
+    # crossover the RATIO ingest/solve is row-independent — see
+    # docs/SCALE.md §Host ingest.
+    chip = _newest_chip_artifact()
+    chip_rate = None
+    if chip is not None:
+        try:
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), chip["file"])) as f:
+                chip_rate = json.load(f).get("value")
+        except (OSError, ValueError):
+            chip_rate = None
+    crossover = None
+    if chip_rate:
+        crossover = {
+            "rows_vs_100it_200k_solve": round(best_rps * 100 / chip_rate),
+            "chip_iters_per_sec": chip_rate,
+            "chip_artifact": chip["file"],
+            "note": "rows the best ingest path decodes in one "
+                    "100-iteration GLMix fit at the frozen chip rate "
+                    "(200k-row shape); solve time scales ~linearly in "
+                    "rows, so beyond the bench shape compare RATES, "
+                    "not row counts",
+        }
     return {
-        "c_rows_per_sec": round(c_rps),
+        "c_rows_per_sec": c_rps,
         "python_rows_per_sec": round(py_rps),
         "c_speedup": round(c_rps / py_rps, 1),
-        "shape": (f"{n} rows x {per_row} nnz (C) / {py_n} rows (python), "
-                  f"d={d}, TrainingExampleAvro with metadataMap ids"),
-        "note": "host-side (no device); crossover vs solve time in "
-                "docs/SCALE.md §Host ingest",
+        "parallel_rows_per_sec": rates,
+        "parallel_speedup_4w": round(rates["4"] / rates["1"], 2),
+        "best_workers": int(best_w),
+        "decode_plus_h2d": h2d,
+        "cpu_cores": cpu_cores,
+        "crossover": crossover,
+        "shape": (f"{n} rows x {per_row} nnz (C paths) / {py_n} rows "
+                  f"(python), d={d}, TrainingExampleAvro with "
+                  "metadataMap ids"),
+        "note": "host-side decode (H2D only in decode_plus_h2d); "
+                "worker scaling is hardware-capped at cpu_cores — "
+                "on a 1-core host the curve is flat-to-negative "
+                "(process startup + transport overhead, no parallel "
+                "decode); crossover analysis in docs/SCALE.md "
+                "§Host ingest",
     }
 
 
@@ -865,6 +978,29 @@ def aot_mf_phase_cost():
         "note": "deviceless v5e AOT cost analysis (loop bodies counted "
                 "once); chip timing still decides — this bounds which "
                 "phase can dominate",
+    }
+
+
+def _newest_chip_artifact():
+    """Newest frozen chip-run artifact (BENCH_full_r*_chip.json) next to
+    this file, with hash + age — the evidence chain a CPU run's headline
+    carries so the driver's tail window still names real chip numbers
+    (VERDICT r5 item 7). None when no frozen artifact exists."""
+    import glob
+    import hashlib
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    files = glob.glob(os.path.join(here, "BENCH_full_r*_chip.json"))
+    if not files:
+        return None
+    newest = max(files, key=os.path.getmtime)
+    with open(newest, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    return {
+        "file": os.path.basename(newest),
+        "sha256": digest[:12],
+        "age_days": round((time.time() - os.path.getmtime(newest)) / 86400,
+                          1),
     }
 
 
@@ -1093,7 +1229,10 @@ def main():
                  "x 25 random-effect features)"
                  + (" [CPU FALLBACK]" if fallback else
                     " [CPU]" if cpu_intentional else "")),
-        "vs_baseline": (round(baseline_s / per_iter, 2)
+        # Like-for-like with the CPU baseline (both amortized, both
+        # RTT-inclusive) — the marginal headline would mix methodologies
+        # into the ratio (ADVICE r5).
+        "vs_baseline": (round(baseline_s / amortized_per_iter, 2)
                         if baseline_s else None),
         "extra": {
             "headline_methodology": ("marginal (t(20it)-t(10it))/10"
@@ -1161,11 +1300,23 @@ def main():
             "scoring_shape": score_shape,
             "aot_v5e_cost": aot_cost,
             "shape_scale": SHAPE_SCALE,
-            "vs_baseline_note": "same JAX code on 1 host CPU (no JVM/Spark "
-                                "available to measure the reference itself)",
+            "vs_baseline_note": "amortized-10it rate vs the amortized "
+                                "1-iteration CPU baseline (like-for-like; "
+                                "the marginal headline is reported "
+                                "separately). Baseline is the same JAX "
+                                "code on 1 host CPU (no JVM/Spark "
+                                "available to measure the reference "
+                                "itself)",
             "tpu_probe": probe_note,
         },
     }
+    # CPU runs (fallback OR intentional) carry the frozen chip evidence
+    # chain: the newest chip artifact's name + hash + age ride both the
+    # full result and the compact headline, with provenance kept honest
+    # (VERDICT r5 item 7 — no relabeling).
+    chip_artifact = None if tpu_ok else _newest_chip_artifact()
+    if chip_artifact is not None:
+        result["chip_artifact"] = chip_artifact
     # Artifact contract (VERDICT r4 weak #2): full result -> file; stdout's
     # final line is a compact headline that any tail-window capture parses.
     full_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1185,6 +1336,8 @@ def main():
         "shape_scale": SHAPE_SCALE,
         "full_result": "BENCH_full.json",
     }
+    if chip_artifact is not None:
+        compact["chip_artifact"] = chip_artifact
     print(json.dumps(compact))
 
 

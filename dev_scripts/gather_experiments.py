@@ -264,13 +264,23 @@ def _variant_args(args, roll_axes, i):
     """Roll the arrays named by ``roll_axes`` (index -> axis) by a
     variant- and process-specific shift; arrays not named stay shared
     (e.g. the coefficient table). Rolled index/mask pairs shift
-    TOGETHER so they stay aligned, and a rolled workload has identical
-    cost shape."""
+    TOGETHER so they stay aligned (paired arrays share an axis length,
+    so the per-axis-length reduction below gives them the same
+    effective shift), and a rolled workload has identical cost shape.
+
+    The effective shift is forced NONZERO per rolled axis: a raw shift
+    that happens to be a multiple of the axis length would make the
+    roll an identity, re-opening the relay-side same-args caching hole
+    this harness exists to close (ADVICE r5)."""
     import jax.numpy as jnp
 
     shift = (1009 + _NONCE) * i
-    return tuple(jnp.roll(a, shift, axis=roll_axes[j])
-                 if j in roll_axes else a
+
+    def roll(a, axis):
+        eff = shift % a.shape[axis] or 1
+        return jnp.roll(a, eff, axis=axis)
+
+    return tuple(roll(a, roll_axes[j]) if j in roll_axes else a
                  for j, a in enumerate(args))
 
 
@@ -284,6 +294,10 @@ def _time_distinct(f, args, roll_axes):
 
     variants = [_variant_args(args, roll_axes, i + 1) for i in range(REPS)]
     jax.block_until_ready(f(*args))
+    # The rolls above are async device work (~48 MB each at candidate
+    # shapes); drain them BEFORE the clock starts or the timed window
+    # absorbs roll cost (ADVICE r5).
+    jax.block_until_ready(variants)
     t0 = time.perf_counter()
     outs = [f(*a) for a in variants]
     jax.block_until_ready(outs)

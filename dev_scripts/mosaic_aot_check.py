@@ -74,11 +74,13 @@ def main() -> int:
     ]
     selected = sys.argv[1:]
     failures = []
+    ran = [0]
 
     def run_group(checks):
         """Shared check runner: time each (name, thunk), print one line,
         record failures (exit-code accounting happens at the end)."""
         for name, thunk in checks:
+            ran[0] += 1
             t0 = time.perf_counter()
             try:
                 thunk()
@@ -239,7 +241,7 @@ def main() -> int:
 
         yield "gather:residue(d=2M is capped)", residue_big_must_fail
 
-    if not selected or any(s in "gather" for s in selected):
+    if not selected or any("gather".startswith(s) for s in selected):
         run_group(gather_checks())
 
     # Sort-permutation sparse layout (docs/SCALE.md §Attacking the
@@ -269,15 +271,24 @@ def main() -> int:
         yield "sortperm:rmatvec(d=2M)", lambda: jax.jit(
             lambda f, u: f.rmatvec(u)).lower(feats, arg((n_r,))).compile()
 
-    if not selected or any(s in "sortperm" for s in selected):
+    # Prefix match, not reversed substring membership: `any(s in "sortperm")`
+    # would let selectors like "t" or "o" silently enable unrelated groups
+    # (ADVICE r5).
+    if not selected or any("sortperm".startswith(s) for s in selected):
         run_group(sortperm_checks())
 
-    if not selected or any(s in "sharded" for s in selected):
+    if not selected or any("sharded".startswith(s) for s in selected):
         run_group(shard_checks())
 
     if failures:
         print(f"FAILED VARIANTS: {failures}")
         return 1
+    if selected and not ran[0]:
+        # A selector that matches nothing must fail loudly, not certify
+        # zero compiles as green (group selectors PREFIX-match 'gather'/
+        # 'sortperm'/'sharded'; variant selectors substring-match names).
+        print(f"NO CHECKS MATCHED SELECTORS {selected!r}")
+        return 2
     print("ALL SELECTED VARIANTS COMPILE ON MOSAIC (v5e, deviceless AOT)")
     return 0
 

@@ -99,6 +99,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-types", default=None,
                    help="extra entity id columns to read from metadataMap "
                         "(defaults to the random-effect types)")
+    p.add_argument("--ingest-workers", default="auto",
+                   help="Avro decode worker processes: 'auto' (usable "
+                        "cores) or an int; >= 2 decodes file shards in "
+                        "parallel with byte-identical output, 1 forces "
+                        "single-process decode")
     p.add_argument("--feature-index-dir", default=None,
                    help="pre-built feature index stores keyed by shard id: "
                         "the reference's partitioned PalDB stores "
@@ -171,9 +176,11 @@ def run(argv=None) -> dict:
         args.train_input_dirs,
         date_range=args.train_date_range,
         date_range_days_ago=args.train_date_range_days_ago)
-    logger.info("reading training data from %s", train_inputs)
+    logger.info("reading training data from %s (ingest workers: %s)",
+                train_inputs, args.ingest_workers)
     data, shard_maps = read_game_dataset(train_inputs, id_types=id_types,
-                                         feature_shard_maps=preloaded_maps)
+                                         feature_shard_maps=preloaded_maps,
+                                         ingest_workers=args.ingest_workers)
     validation = None
     if args.validate_input_dirs:
         validate_inputs = resolve_input_dirs(
@@ -182,7 +189,8 @@ def run(argv=None) -> dict:
             date_range_days_ago=args.validate_date_range_days_ago)
         validation, _ = read_game_dataset(
             validate_inputs, id_types=id_types,
-            feature_shard_maps=shard_maps)
+            feature_shard_maps=shard_maps,
+            ingest_workers=args.ingest_workers)
 
     def parse_grid(s: str):
         return [GLMOptimizationConfiguration.parse(part)

@@ -91,6 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=[t.value for t in NormalizationType])
     p.add_argument("--coefficient-box-constraints", default=None,
                    help="JSON constraint string (GLMSuite format)")
+    p.add_argument("--ingest-workers", default="auto",
+                   help="Avro decode worker processes: 'auto' (usable "
+                        "cores) or an int; >= 2 decodes file shards in "
+                        "parallel with byte-identical output, 1 forces "
+                        "single-process decode")
     p.add_argument("--offheap-indexmap-dir", default=None,
                    help="pre-built feature index stores (the reference's "
                         "partitioned PalDB paldb-partition-<ns>-<N>.dat "
@@ -176,7 +181,8 @@ def _write_feature_summary(out_dir: Path, summary, imap) -> None:
 def _load(path: str, fmt: str, add_intercept: bool, task: TaskType,
           index_map: IndexMap | None = None,
           num_raw_features: int | None = None,
-          selected_features: set | None = None):
+          selected_features: set | None = None,
+          ingest_workers="auto"):
     """index_map / num_raw_features: pass the training map (AVRO) or the
     training feature width before intercept (LIBSVM) when loading validation
     data, so columns decode identically (the reference shares one feature
@@ -184,7 +190,8 @@ def _load(path: str, fmt: str, add_intercept: bool, task: TaskType,
     if fmt == "AVRO":
         mat, y, off, w, _, imap = read_labeled_points(
             path, index_map=index_map, add_intercept=add_intercept,
-            selected_features=selected_features)
+            selected_features=selected_features,
+            ingest_workers=ingest_workers)
         return mat, y, off, w, imap
     if selected_features is not None:
         raise ValueError(
@@ -378,7 +385,8 @@ def run(argv=None) -> dict:
                         "from %s", ns, len(preloaded_map), store_dir)
         mat, y, off, w, imap = _load(
             args.training_data_directory, args.format, add_intercept, task,
-            index_map=preloaded_map, selected_features=selected)
+            index_map=preloaded_map, selected_features=selected,
+            ingest_workers=args.ingest_workers)
         logger.info("loaded %d rows x %d features", *mat.shape)
         validate_data(task, mat, y, off, w,
                       DataValidationType(args.validate_data))
@@ -436,7 +444,8 @@ def run(argv=None) -> dict:
                 args.validating_data_directory, args.format, add_intercept,
                 task, index_map=imap if args.format == "AVRO" else None,
                 num_raw_features=(mat.shape[1] - int(add_intercept)
-                                  if args.format == "LIBSVM" else None))
+                                  if args.format == "LIBSVM" else None),
+                ingest_workers=args.ingest_workers)
             if vmat.shape[1] != mat.shape[1]:
                 raise ValueError(
                     f"validation feature dim {vmat.shape[1]} != "

@@ -1,1 +1,7 @@
-"""Data structures and host-side ingest."""
+"""Data structures and host-side ingest.
+
+Parallel ingest entry points (shard planning, the multi-process decoder
+pool, and the chunked device feeder) live in shard_planner.py,
+parallel_ingest.py and device_feed.py; `avro_reader.read_game_dataset` /
+`read_labeled_points` thread an ``ingest_workers`` knob down to them.
+"""

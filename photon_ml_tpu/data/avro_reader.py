@@ -106,14 +106,17 @@ def _reject_duplicate_features(mat: sp.csr_matrix, index_map: IndexMap,
 
 
 def build_index_map(path, add_intercept: bool = True,
-                    selected_features: Optional[set] = None) -> IndexMap:
+                    selected_features: Optional[set] = None,
+                    ingest_workers=None) -> IndexMap:
     """Scan pass collecting distinct (name, term) keys — the analog of
     DefaultIndexMap generation / FeatureIndexingJob. ``selected_features``
     restricts the map to a whitelist of keys (the reference's
-    createDefaultIndexMapLoader(avroRDD, selectedFeatures))."""
+    createDefaultIndexMapLoader(avroRDD, selectedFeatures)).
+    ``ingest_workers``: see read_labeled_points."""
     from photon_ml_tpu.data.fast_ingest import fast_ingest
 
-    fast = fast_ingest(_avro_paths(path), {}, {}, collect_keys=True)
+    fast = fast_ingest(_avro_paths(path), {}, {}, collect_keys=True,
+                       workers=ingest_workers)
     if fast is not None:
         keys = fast.collected_keys
         if selected_features is not None:
@@ -134,6 +137,7 @@ def read_labeled_points(
     index_map: Optional[IndexMap] = None,
     add_intercept: bool = True,
     selected_features: Optional[set] = None,
+    ingest_workers=None,
 ) -> Tuple[sp.csr_matrix, np.ndarray, np.ndarray, np.ndarray,
            List[Optional[str]], IndexMap]:
     """Returns (features, labels, offsets, weights, uids, index_map).
@@ -141,17 +145,22 @@ def read_labeled_points(
     Unknown features (absent from a supplied index map) are dropped, like
     the reference's ingest. ``selected_features`` (keys) restricts columns
     (GLMSuite selected-features filtering).
+
+    ``ingest_workers``: "auto"/None picks a worker count from the usable
+    cores; >= 2 decodes file shards in a process pool with byte-identical
+    output (data/parallel_ingest.py); 1 forces single-process decode.
     """
     if index_map is None:
         index_map = build_index_map(path, add_intercept=add_intercept,
-                                    selected_features=selected_features)
+                                    selected_features=selected_features,
+                                    ingest_workers=ingest_workers)
     intercept_idx = index_map.intercept_index if add_intercept else -1
 
     from photon_ml_tpu.data.fast_ingest import fast_ingest
 
     fast = fast_ingest(
         _avro_paths(path), {"m": index_map}, {"m": intercept_idx},
-        restrict_keys=selected_features)
+        restrict_keys=selected_features, workers=ingest_workers)
     if fast is not None:
         data_, idx_, indptr_ = fast.shards["m"]
         mat = sp.csr_matrix((data_, idx_, indptr_),
@@ -196,6 +205,7 @@ def read_game_dataset(
     feature_shard_maps: Optional[Dict[str, IndexMap]] = None,
     add_intercept: bool = True,
     default_shard: str = "global",
+    ingest_workers=None,
 ) -> Tuple[GameDataset, Dict[str, IndexMap]]:
     """GAME ingest: one feature shard (default: all features) + entity id
     columns pulled from each record's metadataMap (falling back to uid).
@@ -203,10 +213,14 @@ def read_game_dataset(
     The reference's richer feature-bag/shard configuration
     (GameDriver.prepareFeatureMaps) maps onto ``feature_shard_maps``:
     shard id -> IndexMap restricted to that shard's features.
+
+    ``ingest_workers``: see read_labeled_points — "auto"/None, or a worker
+    count; parallel decode is byte-identical to single-process.
     """
     if feature_shard_maps is None:
         feature_shard_maps = {
-            default_shard: build_index_map(path, add_intercept=add_intercept)}
+            default_shard: build_index_map(path, add_intercept=add_intercept,
+                                           ingest_workers=ingest_workers)}
 
     from photon_ml_tpu.data.fast_ingest import fast_ingest
 
@@ -214,7 +228,7 @@ def read_game_dataset(
         _avro_paths(path), feature_shard_maps,
         {s: (m.intercept_index if add_intercept else -1)
          for s, m in feature_shard_maps.items()},
-        id_types=id_types)
+        id_types=id_types, workers=ingest_workers)
     if fast is not None:
         n = len(fast.labels)
         shards = {}

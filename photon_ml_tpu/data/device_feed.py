@@ -1,0 +1,145 @@
+"""Chunked, overlapped host->device upload for ingest-sized arrays.
+
+Two problems with one ``jax.device_put`` of a multi-GB training matrix:
+(1) the remote-TPU tunnel rejects single uploads beyond ~300 MB (HTTP 413 —
+docs/SCALE.md §Remote-tunnel ingest caveat), and (2) the host-side staging
+(densify / dtype-cast) of chunk k+1 could be running while chunk k is on
+the wire, but a monolithic put serializes them.
+
+``chunked_device_put`` splits on the leading axis and keeps at most
+``depth`` transfers in flight (double-buffered by default): device_put is
+async under JAX, so while chunk k transfers, the python loop is already
+slicing/casting chunk k+1. The result — ``jnp.concatenate`` of the chunks —
+is value-identical to a whole-array put.
+
+``OverlappedUploader`` is the push-style variant for producers that emit
+chunks over time (the multi-process decode pipeline: workers hand the
+parent shard columns while later shards are still decoding —
+data/parallel_ingest.py's ``column_consumer`` hook plugs straight into
+``submit``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+# Default per-transfer cap: comfortably under the tunnel's ~300 MB limit
+# while big enough that per-put dispatch overhead stays negligible.
+DEFAULT_CHUNK_BYTES = 128 << 20
+
+
+def _rows_per_chunk(nbytes_per_row: int, chunk_bytes: int) -> int:
+    return max(1, chunk_bytes // max(1, nbytes_per_row))
+
+
+def chunked_device_put(x, dtype=None, device=None,
+                       chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                       depth: int = 2):
+    """Upload ``x`` (numpy or scipy-sparse-row-sliceable) in leading-axis
+    chunks, ``depth`` transfers in flight; returns one device array equal
+    to ``jnp.asarray(x, dtype)``.
+
+    Sparse input is densified PER CHUNK (``.toarray()`` on the row slice),
+    so the full dense host copy never materializes — the peak host
+    footprint is the CSR plus ``depth`` chunks.
+
+    Device-side peak is transiently ~2x the array during the final
+    ``jnp.concatenate`` (chunks + destination). A donated
+    dynamic-update-slice into a preallocated buffer would cap it at ~1x
+    on TPU, but donation is ignored on CPU, where every functional
+    update would copy the full buffer per chunk — deliberately not done
+    until a workload actually hits the 2x ceiling.
+    """
+    import jax
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    sparse = sp.issparse(x)
+    if sparse:
+        x = x.tocsr()  # coo/dia/... aren't row-sliceable; csr is (no-op
+        # for the csr matrices the ingest paths hand in)
+    else:
+        x = np.asarray(x)
+    n = x.shape[0] if x.ndim else 0
+    # Size chunks by the WIDER of source and target dtypes: the transfer
+    # happens at the target width, so casting int8 -> f32 must not turn a
+    # 128 MB host chunk into a 512 MB wire transfer.
+    itemsize = np.dtype(np.float64).itemsize if sparse else x.dtype.itemsize
+    if dtype is not None:
+        try:
+            itemsize = max(itemsize, np.dtype(dtype).itemsize)
+        except TypeError:
+            pass  # exotic dtype numpy can't size; host itemsize stands
+    elems_per_row = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+    row_bytes = elems_per_row * itemsize
+    total_bytes = n * row_bytes
+
+    def put(chunk):
+        if sparse:
+            chunk = chunk.toarray()
+        a = jnp.asarray(chunk, dtype)
+        return a if device is None else jax.device_put(a, device)
+
+    if x.ndim == 0 or n <= 1 or total_bytes <= chunk_bytes:
+        return put(x)
+
+    rows = _rows_per_chunk(row_bytes, chunk_bytes)
+    parts = []
+    in_flight: deque = deque()
+    for start in range(0, n, rows):
+        a = put(x[start:start + rows])
+        parts.append(a)
+        in_flight.append(a)
+        if len(in_flight) >= depth:
+            # Bound the in-flight window: wait for the OLDEST transfer so
+            # chunk k+depth's host staging overlaps chunks k+1..k+depth-1
+            # on the wire.
+            jax.block_until_ready(in_flight.popleft())
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+class OverlappedUploader:
+    """Push-style double-buffered feeder: ``submit(host_chunk)`` starts an
+    async device transfer and returns immediately (unless ``depth``
+    transfers are already in flight); ``collect()`` waits and concatenates.
+
+    The producer (e.g. the parallel-decode assembly loop) keeps decoding
+    while submitted chunks ride the wire — H2D of chunk k overlaps decode
+    of chunk k+1, which is the whole point.
+
+    Chunks are copied at submit time (``jnp.asarray``), so callers may hand
+    in views over transient buffers (shared-memory segments included).
+    """
+
+    def __init__(self, dtype=None, device=None, depth: int = 2,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+        self._dtype = dtype
+        self._device = device
+        self._depth = max(1, depth)
+        self._chunk_bytes = chunk_bytes
+        self._parts: list = []
+        self._in_flight: deque = deque()
+
+    def submit(self, chunk) -> None:
+        import jax
+
+        a = chunked_device_put(chunk, self._dtype, self._device,
+                               self._chunk_bytes, self._depth)
+        self._parts.append(a)
+        self._in_flight.append(a)
+        if len(self._in_flight) >= self._depth:
+            jax.block_until_ready(self._in_flight.popleft())
+
+    def collect(self):
+        """Device concatenation of everything submitted (None if empty)."""
+        import jax.numpy as jnp
+
+        if not self._parts:
+            return None
+        out = (self._parts[0] if len(self._parts) == 1
+               else jnp.concatenate(self._parts, axis=0))
+        self._parts = []
+        self._in_flight.clear()
+        return out

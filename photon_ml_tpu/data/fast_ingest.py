@@ -173,6 +173,7 @@ def fast_ingest(
     id_types: Sequence[str] = (),
     collect_keys: bool = False,
     restrict_keys: Optional[set] = None,
+    workers=None,
 ) -> Optional[FastIngestResult]:
     """Native whole-file ingest. Returns None when the native module is
     missing or any file's schema doesn't fit the training layout — callers
@@ -180,10 +181,32 @@ def fast_ingest(
 
     ``restrict_keys``: selected-features whitelist (lookups happen against
     the restricted dict).
+
+    ``workers``: "auto"/None resolves to the usable core count; an int >= 2
+    decodes block-range shards in a process pool (data/parallel_ingest.py)
+    with byte-identical output (values and row order); 1 forces this
+    single-process path. The parallel path declines (returns None
+    internally) on inputs too small to amortize the pool in auto mode, and
+    this in-process path then runs as before.
     """
     native = load_avro_native()
     if native is None or not hasattr(native, "decode_training_block"):
         return None
+
+    from photon_ml_tpu.data.parallel_ingest import (
+        parallel_fast_ingest,
+        resolve_ingest_workers,
+    )
+
+    auto = workers in (None, "auto", 0)
+    n_workers = resolve_ingest_workers(workers)
+    if n_workers > 1:
+        result = parallel_fast_ingest(
+            paths, shard_maps, intercepts, id_types=id_types,
+            collect_keys=collect_keys, restrict_keys=restrict_keys,
+            workers=n_workers, auto=auto)
+        if result is not None:
+            return result
 
     shard_names = list(shard_maps)
     dicts = []

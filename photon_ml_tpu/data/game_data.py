@@ -121,16 +121,21 @@ class GameDataset:
         (the analog of FixedEffectDataSet, ml/data/FixedEffectDataSet.scala:29-103).
         ``sparse_layout`` picks the below-threshold layout ("csr" |
         "bucketed_ell" | "sort_permute_ell" — see features_to_device)."""
+        from photon_ml_tpu.data.device_feed import chunked_device_put
+
         mat = self.feature_shards[shard_id]
         feats = features_to_device(mat, dtype, dense_threshold,
                                    sparse_layout=sparse_layout)
         off = self.offsets if extra_offsets is None else \
             self.offsets + extra_offsets
+        # Column vectors ride the same chunked uploader as the features:
+        # a single put below the chunk threshold, bounded overlapped
+        # transfers above it (billions-of-rows datasets).
         return GLMBatch(
             features=feats,
-            labels=jnp.asarray(self.responses, dtype),
-            offsets=jnp.asarray(off, dtype),
-            weights=jnp.asarray(self.weights, dtype),
+            labels=chunked_device_put(self.responses, dtype),
+            offsets=chunked_device_put(off, dtype),
+            weights=chunked_device_put(self.weights, dtype),
         )
 
     def subset(self, rows: np.ndarray) -> "GameDataset":

@@ -1,0 +1,185 @@
+"""Shard planner: split Avro container files into block-aligned byte-range
+shards for multi-process ingest.
+
+An Avro object container file is a header followed by independent blocks
+(count varint, byte-size varint, payload, 16-byte sync marker). Blocks are
+self-contained — a worker that knows the file's codec, sync marker and a
+block's byte offset can decode it without touching the header — so the
+natural shard unit is a CONSECUTIVE run of blocks. Scanning the block index
+reads only the two varints per block (payloads are seeked over), so
+planning costs O(blocks) seeks, not O(bytes).
+
+Shards never span files and carry a global sequence number; a consumer that
+assembles results in sequence order reproduces the single-process row order
+exactly (the worker-count-invariance contract of
+data/parallel_ingest.py).
+
+This is the single-host analog of the reference's executor-parallel decode
+(ml/data/AvroDataReader.scala:86-214), where HDFS splits play the role of
+the block-range shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Any, List, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSpan:
+    """One container block: ``offset`` is the file position of its count
+    varint; ``payload_bytes`` the (possibly compressed) payload size;
+    ``count`` the records it holds."""
+
+    offset: int
+    payload_bytes: int
+    count: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FileBlockIndex:
+    """Everything a worker needs to decode any block run of one file."""
+
+    path: str
+    codec: str  # "null" | "deflate"
+    sync: bytes  # 16-byte sync marker
+    schema_json: Any  # writer schema (parsed JSON), for layout compilation
+    blocks: List[BlockSpan]
+
+    @property
+    def num_rows(self) -> int:
+        return sum(b.count for b in self.blocks)
+
+    @property
+    def num_bytes(self) -> int:
+        return sum(b.payload_bytes for b in self.blocks)
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestShard:
+    """A consecutive block run of one file, assigned to one worker.
+
+    ``seq`` is the global assembly position: results concatenated in seq
+    order are byte-identical to a single-process scan of the same paths.
+    """
+
+    seq: int
+    path: str
+    codec: str
+    sync: bytes
+    offset: int  # file position of the first block's count varint
+    num_blocks: int
+    num_rows: int
+    num_bytes: int
+
+    def label(self) -> str:
+        """Human-readable shard name for error messages."""
+        return (f"{os.path.basename(self.path)}"
+                f"[@{self.offset}, {self.num_blocks} blocks, "
+                f"{self.num_rows} rows]")
+
+
+def scan_container_blocks(path) -> FileBlockIndex:
+    """Index one container file's blocks without decompressing payloads.
+
+    Raises ValueError naming the file and offset on any structural damage
+    (truncated varint/payload, sync mismatch) — the same failures a decode
+    would hit, surfaced before any worker pool spins up.
+    """
+    import json
+
+    from photon_ml_tpu.io.avro_codec import MAGIC, _read_long, read_datum
+
+    path = str(path)
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta = read_datum(f, {"type": "map", "values": "bytes"})
+        schema_json = json.loads(meta["avro.schema"].decode())
+        codec = meta.get("avro.codec", b"null").decode()
+        if codec not in ("null", "deflate"):
+            raise ValueError(f"{path}: unsupported codec {codec!r}")
+        sync = f.read(16)
+        if len(sync) != 16:
+            raise ValueError(f"{path}: truncated header sync marker")
+
+        blocks: List[BlockSpan] = []
+        while True:
+            offset = f.tell()
+            first = f.read(1)
+            if not first:
+                break
+            f.seek(-1, 1)
+            try:
+                count = _read_long(f)
+                size = _read_long(f)
+            except EOFError as e:
+                raise ValueError(
+                    f"{path}: truncated block header at offset {offset}: "
+                    f"{e}") from e
+            if count < 0 or size < 0:
+                raise ValueError(
+                    f"{path}: negative block header at offset {offset} "
+                    f"(count={count}, size={size})")
+            f.seek(size, 1)
+            marker = f.read(16)
+            if len(marker) != 16:
+                raise ValueError(
+                    f"{path}: truncated block payload/sync at offset "
+                    f"{offset} (expected {size} payload bytes + sync)")
+            if marker != sync:
+                raise ValueError(
+                    f"{path}: sync marker mismatch after block at offset "
+                    f"{offset}")
+            blocks.append(BlockSpan(offset, size, count))
+    return FileBlockIndex(path=path, codec=codec, sync=sync,
+                          schema_json=schema_json, blocks=blocks)
+
+
+def plan_shards(indexes: Sequence[FileBlockIndex],
+                num_shards: int) -> List[IngestShard]:
+    """Group consecutive blocks into ~``num_shards`` byte-balanced shards.
+
+    File order and within-file block order are preserved (seq numbers are
+    assigned in scan order). Shards never cross file boundaries, so every
+    shard has exactly one schema/codec/sync. Files smaller than the byte
+    target still get their own shard; the result may therefore hold up to
+    ``num_shards + len(indexes)`` entries and never fewer than
+    ``len(indexes)`` (for non-empty files).
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    total_bytes = sum(ix.num_bytes for ix in indexes)
+    target = max(1, -(-total_bytes // num_shards))  # ceil
+
+    shards: List[IngestShard] = []
+    seq = 0
+    for ix in indexes:
+        run: List[BlockSpan] = []
+        run_bytes = 0
+
+        def flush():
+            nonlocal run, run_bytes, seq
+            if not run:
+                return
+            shards.append(IngestShard(
+                seq=seq, path=ix.path, codec=ix.codec, sync=ix.sync,
+                offset=run[0].offset, num_blocks=len(run),
+                num_rows=sum(b.count for b in run), num_bytes=run_bytes))
+            seq += 1
+            run, run_bytes = [], 0
+
+        for b in ix.blocks:
+            run.append(b)
+            run_bytes += b.payload_bytes
+            if run_bytes >= target:
+                flush()
+        flush()
+    return shards
+
+
+def scan_paths(paths: Sequence) -> List[FileBlockIndex]:
+    """Block indexes for a list of files, in the given order."""
+    return [scan_container_blocks(Path(p)) for p in paths]

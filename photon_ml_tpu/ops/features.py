@@ -998,11 +998,16 @@ def features_to_device(mat, dtype=jnp.float32,
         raise ValueError(
             f"unknown sparse_layout {sparse_layout!r}: expected "
             "'csr', 'bucketed_ell', or 'sort_permute_ell'")
+    from photon_ml_tpu.data.device_feed import chunked_device_put
+
     dense_dt = storage_dtype if storage_dtype is not None else dtype
     if sp.issparse(mat):
         density = mat.nnz / max(1, mat.shape[0] * mat.shape[1])
         if density >= dense_threshold:
-            return DenseFeatures(jnp.asarray(mat.toarray(), dense_dt))
+            # Chunked upload: densify + cast per row chunk, double-buffered
+            # H2D — never materializes the full dense host copy and stays
+            # under the tunnel's single-transfer cap (docs/SCALE.md).
+            return DenseFeatures(chunked_device_put(mat, dense_dt))
         if storage_dtype is not None:
             import warnings
 
@@ -1020,4 +1025,4 @@ def features_to_device(mat, dtype=jnp.float32,
         if sparse_layout == "sort_permute_ell":
             return sort_permute_ell_from_scipy(mat, dtype=dtype)
         return csr_from_scipy(mat, dtype=dtype)
-    return DenseFeatures(jnp.asarray(np.asarray(mat), dense_dt))
+    return DenseFeatures(chunked_device_put(np.asarray(mat), dense_dt))

@@ -100,3 +100,21 @@ def test_variant_args_rolls_named_arrays_together(monkeypatch):
     # The real per-process nonce keeps cross-process dispatches distinct.
     monkeypatch.undo()
     assert 1 <= ge._NONCE <= 997
+
+
+def test_variant_args_forces_nonzero_effective_shift(monkeypatch):
+    """A raw shift that is a MULTIPLE of the rolled axis length must not
+    degrade to an identity roll (that would re-open the same-args caching
+    hole): the effective shift falls back to 1 (ADVICE r5)."""
+    import jax.numpy as jnp
+
+    import dev_scripts.gather_experiments as ge
+
+    monkeypatch.setattr(ge, "_NONCE", 3)  # (1009+3)*1 % 4 == 0
+    a = jnp.arange(8).reshape(2, 4)
+    shift = (1009 + 3) * 1
+    assert shift % a.shape[1] == 0  # raw roll WOULD be the identity
+    (va,) = ge._variant_args((a,), {0: 1}, 1)
+    assert not np.array_equal(np.asarray(va), np.asarray(a))
+    np.testing.assert_array_equal(np.asarray(va),
+                                  np.roll(np.asarray(a), 1, axis=1))
