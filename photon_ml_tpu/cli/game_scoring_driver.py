@@ -1,6 +1,23 @@
 """GAME scoring driver (reference: ml/cli/game/scoring/Driver.scala:36-265):
 load a saved GAME model, score a dataset, write ScoringResultAvro, optionally
-evaluate."""
+evaluate.
+
+Two execution shapes:
+
+- default: the whole input is read into one GameDataset and scored in a
+  single device dispatch (``DeviceGameScorer`` — dataset-resident, exact
+  shapes), with a clean host-numpy fallback when a sub-model type is not
+  device-scorable;
+- ``--stream --batch-rows N``: arbitrarily large Avro inputs score in
+  O(N) host memory through the streaming serving engine
+  (photon_ml_tpu/serving/): model uploaded once, batches padded into
+  static compile buckets, featureization of batch k+1 overlapped with
+  the device dispatch of batch k, scores written per batch. Caveat:
+  ``--evaluators`` additionally accumulates the per-row EVALUATION
+  columns (score/label/offset/weight + entity-id strings) across the
+  whole input — features never accumulate, but metric computation is
+  O(total rows); omit evaluators to keep streaming strictly bounded.
+"""
 
 from __future__ import annotations
 
@@ -8,11 +25,15 @@ import argparse
 import json
 import sys
 import time
+from collections import deque
 from pathlib import Path
 
 import numpy as np
 
-from photon_ml_tpu.data.avro_reader import read_game_dataset
+from photon_ml_tpu.data.avro_reader import (
+    iter_game_dataset_batches,
+    read_game_dataset,
+)
 from photon_ml_tpu.evaluation import build_evaluator
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import write_container
@@ -37,13 +58,61 @@ def build_parser() -> argparse.ArgumentParser:
                         "<model-dir>/feature-indexes)")
     p.add_argument("--evaluators", default=None)
     p.add_argument("--id-types", default=None)
+    p.add_argument("--stream", action="store_true",
+                   help="score through the streaming serving engine in "
+                        "bounded memory (one --batch-rows batch of rows "
+                        "resident at a time; note --evaluators still "
+                        "accumulates per-row evaluation columns)")
+    p.add_argument("--batch-rows", type=int, default=4096,
+                   help="rows per streamed scoring batch (--stream only)")
     return p
+
+
+def _maybe_enable_cpu_x64():
+    """On CPU, enable x64 for this driver process (when not already on)
+    BEFORE the model loads, so coefficients and scores keep the f64
+    precision the pre-device host-numpy path always had; on real
+    accelerators x64 stays off and scoring runs f32 (the serving
+    dtype)."""
+    import jax
+
+    if not jax.config.jax_enable_x64 and jax.default_backend() == "cpu":
+        try:
+            jax.config.update("jax_enable_x64", True)
+        except Exception:  # noqa: BLE001 — precision upgrade best-effort
+            pass
+
+
+def _scoring_dtype():
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def _device_scores(model, data, logger):
+    """Score a resident dataset on device; host-numpy fallback when a
+    sub-model family is not device-scorable (same scores either way).
+
+    Only scorer CONSTRUCTION may trigger the fallback — that is where the
+    unsupported-sub-model TypeError contract lives; a TypeError out of the
+    scoring dispatch itself would be a real bug and must surface."""
+    from photon_ml_tpu.models.device_scoring import DeviceGameScorer
+
+    try:
+        scorer = DeviceGameScorer(model, data, dtype=_scoring_dtype())
+    except TypeError as e:
+        logger.info("device scorer unavailable for this model (%s); "
+                    "falling back to host numpy scoring", e)
+        return model.score(data), "host"
+    return np.asarray(scorer.score(model), np.float64), "device"
 
 
 def run(argv=None) -> dict:
     from photon_ml_tpu.cli import _honor_jax_platforms_env
 
     _honor_jax_platforms_env()
+    _maybe_enable_cpu_x64()
     args = build_parser().parse_args(argv)
     out_dir = Path(args.output_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
@@ -70,34 +139,115 @@ def run(argv=None) -> dict:
     inputs = resolve_input_dirs(
         args.input_dirs, date_range=args.date_range,
         date_range_days_ago=args.date_range_days_ago)
-    data, _ = read_game_dataset(inputs, id_types=id_types,
-                                feature_shard_maps=shard_maps)
-    scores = model.score(data)
-    logger.info("scored %d rows", data.num_rows)
 
-    uids = data.uids if data.uids is not None else \
-        np.asarray([str(i) for i in range(data.num_rows)])
+    evaluators = [build_evaluator(s.strip())
+                  for s in (args.evaluators or "").split(",") if s.strip()]
     scores_dir = out_dir / "scores"
     scores_dir.mkdir(exist_ok=True)
-    write_container(
-        scores_dir / "part-00000.avro", schemas.SCORING_RESULT,
-        [{"uid": str(u), "predictionScore": float(s + o),
-          "label": float(l), "metadataMap": None}
-         for u, s, o, l in zip(uids, scores, data.offsets, data.responses)])
+    scores_path = scores_dir / "part-00000.avro"
+
+    if args.stream:
+        summary = _run_stream(args, inputs, id_types, shard_maps, model,
+                              evaluators, scores_path, logger)
+    else:
+        data, _ = read_game_dataset(inputs, id_types=id_types,
+                                    feature_shard_maps=shard_maps)
+        scores, path_used = _device_scores(model, data, logger)
+        logger.info("scored %d rows (%s path)", data.num_rows, path_used)
+
+        uids = data.uids if data.uids is not None else \
+            np.asarray([str(i) for i in range(data.num_rows)])
+        write_container(
+            scores_path, schemas.SCORING_RESULT,
+            [{"uid": str(u), "predictionScore": float(s + o),
+              "label": float(l), "metadataMap": None}
+             for u, s, o, l in zip(uids, scores, data.offsets,
+                                   data.responses)])
+        metrics = {ev.name: ev.evaluate_dataset(scores, data)
+                   for ev in evaluators}
+        summary = {
+            "numRows": int(data.num_rows),
+            "metrics": metrics,
+            "scoringPath": path_used,
+        }
+
+    summary["totalSeconds"] = time.perf_counter() - t0
+    (out_dir / "metrics.json").write_text(json.dumps(summary, indent=2))
+    logger.info("scoring done: %s", summary["metrics"])
+    return summary
+
+
+def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
+                scores_path, logger) -> dict:
+    """Bounded-memory scoring: Avro batches -> serving engine pipeline ->
+    incremental ScoringResultAvro writes. Only evaluation columns (when
+    evaluators are requested) accumulate across batches — never features —
+    so metrics cost O(total rows) of scalars/id strings while feature
+    memory stays O(batch_rows)."""
+    from photon_ml_tpu.data.game_data import GameDataset
+    from photon_ml_tpu.serving import StreamingGameScorer
+
+    try:
+        engine = StreamingGameScorer(model, dtype=_scoring_dtype())
+    except TypeError as e:
+        raise SystemExit(
+            f"--stream requires a device-scorable model: {e}") from e
+
+    batches = iter_game_dataset_batches(
+        inputs, id_types=id_types, feature_shard_maps=shard_maps,
+        batch_rows=args.batch_rows)
+    held: deque = deque()  # datasets whose dispatch is in flight
+    counters = {"rows": 0, "batches": 0}
+    acc = {"scores": [], "responses": [], "offsets": [], "weights": [],
+           "ids": {t: [] for t in id_types}} if evaluators else None
+
+    def feed():
+        for ds in batches:
+            held.append(ds)
+            yield ds
+
+    def scored_records():
+        for scores in engine.score_stream(feed()):
+            ds = held.popleft()
+            counters["rows"] += ds.num_rows
+            counters["batches"] += 1
+            if acc is not None:
+                acc["scores"].append(scores)
+                acc["responses"].append(ds.responses)
+                acc["offsets"].append(ds.offsets)
+                acc["weights"].append(ds.weights)
+                for t in id_types:
+                    col = ds.id_columns[t]
+                    acc["ids"][t].append(col.vocabulary[col.codes])
+            uids = ds.uids if ds.uids is not None else \
+                np.asarray([str(i) for i in range(ds.num_rows)])
+            for u, s, o, l in zip(uids, scores, ds.offsets, ds.responses):
+                yield {"uid": str(u), "predictionScore": float(s + o),
+                       "label": float(l), "metadataMap": None}
+
+    write_container(scores_path, schemas.SCORING_RESULT, scored_records())
+    logger.info("scored %d rows in %d streamed batches (batch-rows=%d)",
+                counters["rows"], counters["batches"], args.batch_rows)
 
     metrics = {}
-    for spec in (args.evaluators or "").split(","):
-        if spec.strip():
-            ev = build_evaluator(spec.strip())
-            metrics[ev.name] = ev.evaluate_dataset(scores, data)
-    summary = {
-        "numRows": int(data.num_rows),
+    if evaluators and acc["scores"]:
+        eval_data = GameDataset.build(
+            responses=np.concatenate(acc["responses"]),
+            feature_shards={},
+            ids={t: np.concatenate(v) for t, v in acc["ids"].items()},
+            offsets=np.concatenate(acc["offsets"]),
+            weights=np.concatenate(acc["weights"]))
+        scores_all = np.concatenate(acc["scores"])
+        metrics = {ev.name: ev.evaluate_dataset(scores_all, eval_data)
+                   for ev in evaluators}
+    return {
+        "numRows": counters["rows"],
         "metrics": metrics,
-        "totalSeconds": time.perf_counter() - t0,
+        "scoringPath": "streaming-engine",
+        "numBatches": counters["batches"],
+        "batchRows": args.batch_rows,
+        "engine": engine.stats(),
     }
-    (out_dir / "metrics.json").write_text(json.dumps(summary, indent=2))
-    logger.info("scoring done: %s", metrics)
-    return summary
 
 
 def main() -> None:

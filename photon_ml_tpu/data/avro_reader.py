@@ -199,6 +199,116 @@ def read_labeled_points(
             np.asarray(weights), uids, index_map)
 
 
+class _GameBatchBuilder:
+    """Per-record GAME decode state — the ONE copy of the python-path
+    record semantics (label/offset/weight/uid, metadataMap id extraction,
+    per-shard feature + intercept append, duplicate-feature rejection at
+    build), shared by ``read_game_dataset``'s fallback loop and
+    ``iter_game_dataset_batches``."""
+
+    def __init__(self, feature_shard_maps: Dict[str, IndexMap],
+                 id_types: Sequence[str], add_intercept: bool):
+        self._maps = feature_shard_maps
+        self._id_types = id_types
+        self._add_intercept = add_intercept
+        self._builders = {s: {"data": [], "indices": [], "indptr": [0]}
+                          for s in feature_shard_maps}
+        self._labels: list = []
+        self._offsets: list = []
+        self._weights: list = []
+        self._uids: list = []
+        self._ids: Dict[str, list] = {t: [] for t in id_types}
+
+    def __len__(self) -> int:
+        return len(self._labels)
+
+    def append(self, rec: dict) -> None:
+        self._labels.append(_record_label(rec))
+        self._offsets.append(float(rec.get("offset") or 0.0))
+        w = rec.get("weight")
+        self._weights.append(1.0 if w is None else float(w))
+        self._uids.append(rec.get("uid"))
+        metadata = rec.get("metadataMap") or {}
+        for t in self._id_types:
+            v = metadata.get(t)
+            if v is None:
+                raise ValueError(
+                    f"record is missing id type {t!r} in metadataMap")
+            self._ids[t].append(str(v))
+        for shard, imap in self._maps.items():
+            b = self._builders[shard]
+            for f in _record_features(rec):
+                idx = imap.get_index(feature_key(f["name"],
+                                                 f.get("term") or ""))
+                if idx >= 0:
+                    b["indices"].append(idx)
+                    b["data"].append(float(f["value"]))
+            ii = imap.intercept_index
+            if self._add_intercept and ii >= 0:
+                b["indices"].append(ii)
+                b["data"].append(1.0)
+            b["indptr"].append(len(b["indices"]))
+
+    def build(self) -> GameDataset:
+        n = len(self._labels)
+        shards = {}
+        for shard, imap in self._maps.items():
+            b = self._builders[shard]
+            m = sp.csr_matrix(
+                (np.asarray(b["data"]),
+                 np.asarray(b["indices"], np.int64),
+                 np.asarray(b["indptr"], np.int64)), shape=(n, len(imap)))
+            _reject_duplicate_features(m, imap, self._uids, shard)
+            shards[shard] = m
+        return GameDataset.build(
+            responses=np.asarray(self._labels),
+            feature_shards=shards,
+            ids={t: np.asarray(v) for t, v in self._ids.items()},
+            offsets=np.asarray(self._offsets),
+            weights=np.asarray(self._weights),
+            uids=np.asarray([u if u is not None else ""
+                             for u in self._uids]),
+        )
+
+
+def iter_game_dataset_batches(
+    path,
+    id_types: Sequence[str],
+    feature_shard_maps: Dict[str, IndexMap],
+    batch_rows: int,
+    add_intercept: bool = True,
+) -> Iterator[GameDataset]:
+    """Streaming GAME ingest: yield GameDatasets of <= ``batch_rows`` rows.
+
+    The bounded-memory feeder for the serving engine's scoring stream
+    (cli/game_scoring_driver --stream): only one batch of rows is ever
+    resident on the host, so arbitrarily large Avro inputs score in
+    O(batch_rows) memory. Record decoding is ``read_game_dataset``'s own
+    row loop (shared ``_GameBatchBuilder`` — same duplicate-feature
+    rejection, same metadataMap id extraction); each batch's entity
+    vocabularies are batch-local — consumers joining against a model
+    vocabulary must map through entity NAMES, which is exactly what the
+    serving engine does.
+
+    KNOWN LIMIT: this feeder decodes through the pure-python record path
+    — the C block decoder (fast_ingest / parallel_ingest) decodes whole
+    files, not bounded batches, so it cannot back this generator yet.
+    Streaming the native decoder per block run is the ROADMAP follow-up;
+    until then decode (~10k rows/s/core) bounds --stream throughput.
+    """
+    if batch_rows < 1:
+        raise ValueError(f"batch_rows must be >= 1, got {batch_rows}")
+    batch = _GameBatchBuilder(feature_shard_maps, id_types, add_intercept)
+    for rec in iter_records(path):
+        batch.append(rec)
+        if len(batch) >= batch_rows:
+            yield batch.build()
+            batch = _GameBatchBuilder(feature_shard_maps, id_types,
+                                      add_intercept)
+    if len(batch):
+        yield batch.build()
+
+
 def read_game_dataset(
     path,
     id_types: Sequence[str],
@@ -249,55 +359,7 @@ def read_game_dataset(
         )
         return data, feature_shard_maps
 
-    shard_builders = {
-        s: {"data": [], "indices": [], "indptr": [0]}
-        for s in feature_shard_maps}
-    labels, offsets, weights, uids = [], [], [], []
-    ids: Dict[str, list] = {t: [] for t in id_types}
-
+    batch = _GameBatchBuilder(feature_shard_maps, id_types, add_intercept)
     for rec in iter_records(path):
-        labels.append(_record_label(rec))
-        offsets.append(float(rec.get("offset") or 0.0))
-        w = rec.get("weight")
-        weights.append(1.0 if w is None else float(w))
-        uids.append(rec.get("uid"))
-        metadata = rec.get("metadataMap") or {}
-        for t in id_types:
-            v = metadata.get(t)
-            if v is None:
-                raise ValueError(
-                    f"record is missing id type {t!r} in metadataMap")
-            ids[t].append(str(v))
-        for shard, imap in feature_shard_maps.items():
-            b = shard_builders[shard]
-            for f in _record_features(rec):
-                idx = imap.get_index(feature_key(f["name"],
-                                                 f.get("term") or ""))
-                if idx >= 0:
-                    b["indices"].append(idx)
-                    b["data"].append(float(f["value"]))
-            ii = imap.intercept_index
-            if add_intercept and ii >= 0:
-                b["indices"].append(ii)
-                b["data"].append(1.0)
-            b["indptr"].append(len(b["indices"]))
-
-    n = len(labels)
-    shards = {}
-    for shard, imap in feature_shard_maps.items():
-        b = shard_builders[shard]
-        m = sp.csr_matrix(
-            (np.asarray(b["data"]), np.asarray(b["indices"], np.int64),
-             np.asarray(b["indptr"], np.int64)), shape=(n, len(imap)))
-        _reject_duplicate_features(m, imap, uids, shard)
-        shards[shard] = m
-
-    data = GameDataset.build(
-        responses=np.asarray(labels),
-        feature_shards=shards,
-        ids={t: np.asarray(v) for t, v in ids.items()},
-        offsets=np.asarray(offsets),
-        weights=np.asarray(weights),
-        uids=np.asarray([u if u is not None else "" for u in uids]),
-    )
-    return data, feature_shard_maps
+        batch.append(rec)
+    return batch.build(), feature_shard_maps

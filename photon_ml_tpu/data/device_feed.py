@@ -100,6 +100,51 @@ def chunked_device_put(x, dtype=None, device=None,
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
+class InFlightWindow:
+    """Bounded window of in-flight async device work.
+
+    The shared scheduling primitive behind every overlapped host<->device
+    pipeline here: ``push(item, ready=...)`` enqueues a unit of async work
+    and — once ``depth`` units are in flight — BLOCKS on the oldest one
+    and returns its item (else None). The caller's loop body between
+    pushes (slicing/casting the next chunk, featureizing the next request
+    batch) thereby overlaps the transfers/dispatches already on the wire.
+    Used by ``OverlappedUploader`` (decode ‖ H2D) and the serving
+    engine's featureize -> H2D -> score pipeline (host work for batch k+1
+    ‖ device dispatch of batch k).
+    """
+
+    def __init__(self, depth: int = 2):
+        self._depth = max(1, depth)
+        self._q: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, item, ready=None):
+        """Enqueue ``item``; block on/return the oldest item when the
+        window is full, else None. ``ready`` (default: item itself) is
+        what jax.block_until_ready waits on — pass the device arrays when
+        item is a richer record."""
+        import jax
+
+        self._q.append((item, item if ready is None else ready))
+        if len(self._q) >= self._depth:
+            old_item, old_ready = self._q.popleft()
+            jax.block_until_ready(old_ready)
+            return old_item
+        return None
+
+    def drain(self):
+        """Yield the remaining items oldest-first, blocking on each."""
+        import jax
+
+        while self._q:
+            item, ready = self._q.popleft()
+            jax.block_until_ready(ready)
+            yield item
+
+
 class OverlappedUploader:
     """Push-style double-buffered feeder: ``submit(host_chunk)`` starts an
     async device transfer and returns immediately (unless ``depth``
@@ -120,17 +165,13 @@ class OverlappedUploader:
         self._depth = max(1, depth)
         self._chunk_bytes = chunk_bytes
         self._parts: list = []
-        self._in_flight: deque = deque()
+        self._window = InFlightWindow(depth)
 
     def submit(self, chunk) -> None:
-        import jax
-
         a = chunked_device_put(chunk, self._dtype, self._device,
                                self._chunk_bytes, self._depth)
         self._parts.append(a)
-        self._in_flight.append(a)
-        if len(self._in_flight) >= self._depth:
-            jax.block_until_ready(self._in_flight.popleft())
+        self._window.push(a)
 
     def collect(self):
         """Device concatenation of everything submitted (None if empty)."""
@@ -141,5 +182,5 @@ class OverlappedUploader:
         out = (self._parts[0] if len(self._parts) == 1
                else jnp.concatenate(self._parts, axis=0))
         self._parts = []
-        self._in_flight.clear()
+        self._window = InFlightWindow(self._depth)
         return out

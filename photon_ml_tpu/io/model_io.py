@@ -141,11 +141,11 @@ class RandomEffectModelSnapshot:
         return len(self.vocabulary)
 
     def score_numpy(self, data) -> np.ndarray:
+        from photon_ml_tpu.utils.vocab import vocab_code_lookup
+
         mat = data.feature_shards[self.feature_shard_id].tocsr()
         col = data.id_columns[self.random_effect_type]
-        idx = {str(n): i for i, n in enumerate(self.vocabulary)}
-        mapped = np.asarray(
-            [idx.get(str(n), -1) for n in col.vocabulary], np.int64)[col.codes]
+        mapped = vocab_code_lookup(self.vocabulary, col.vocabulary)[col.codes]
         valid = mapped >= 0
         scores = np.zeros(data.num_rows)
         if valid.any():

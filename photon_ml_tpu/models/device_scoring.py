@@ -29,7 +29,9 @@ from photon_ml_tpu.models.fixed_effect import FixedEffectModel
 from photon_ml_tpu.models.game_model import GameModel
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
 from photon_ml_tpu.models.random_effect import RandomEffectModel
-from photon_ml_tpu.ops.features import CSRFeatures, features_to_device
+from photon_ml_tpu.ops.features import features_to_device
+from photon_ml_tpu.serving import kernels
+from photon_ml_tpu.utils.vocab import vocab_code_lookup
 
 Array = jax.Array
 
@@ -38,55 +40,48 @@ def _mapped_codes(data: GameDataset, effect_type: str,
                   model_vocab: np.ndarray) -> np.ndarray:
     """Map the dataset's per-row entity codes into a model's vocabulary
     (-1 = entity unknown to the model, scores 0 — the reference's
-    missing-join semantics)."""
+    missing-join semantics). Vectorized searchsorted join — no per-entry
+    python dict on the scoring path."""
     col = data.id_columns[effect_type]
-    idx = {str(n): i for i, n in enumerate(model_vocab)}
-    lookup = np.asarray([idx.get(str(n), -1) for n in col.vocabulary],
-                        np.int32)
+    lookup = vocab_code_lookup(model_vocab, col.vocabulary).astype(np.int32)
     return lookup[col.codes]
 
 
+# The actual scoring math lives in serving/kernels.py, shared with the
+# streaming engine; these wrappers adapt it to score_all's uniform
+# (sdata, params, dtype, static) signature.
+
 def _score_fixed(sdata, params, dtype, static):
     feats, = sdata
-    return feats.matvec(params.astype(dtype))
+    return kernels.score_fixed(feats, params, dtype)
 
 
 def _score_random(sdata, params, dtype, static):
     """Assemble the entity->global-coefficients matrix from the model's
     bucketed blocks on device, then contract it against the validation
-    shard (dense product or CSR segment-sum). The projection matrix (when
-    the model carries one — projected/factored random effects) is a PARAM:
-    factored models learn it, so it changes across scoring calls."""
+    shard. The projection matrix (projected/factored random effects) is a
+    PARAM: factored models learn it, so it changes across scoring calls —
+    hence assemble-per-dispatch, unlike the serving engine's
+    assemble-once-at-upload."""
     feats, mapped, block_static = sdata
     n_codes, d_global = static
     coefs, proj = params
-    M = jnp.zeros((n_codes + 1, d_global + 1), dtype)
-    for (codes_b, fidx_b), coefs_b in zip(block_static, coefs):
-        c = coefs_b.astype(dtype)
-        if proj is not None:
-            k = proj.shape[0]
-            M = M.at[codes_b, :d_global].add(c[:, :k] @ proj.astype(dtype))
-        else:
-            cols = jnp.where(fidx_b >= 0, fidx_b, d_global)
-            M = M.at[codes_b[:, None], cols].add(c)
-    M = M[:, :d_global]
-    rows = jnp.where(mapped >= 0, mapped, n_codes)
-    if isinstance(feats, CSRFeatures):
-        contrib = feats.values * M[rows[feats.row_ids], feats.col_ids]
-        return jax.ops.segment_sum(contrib, feats.row_ids,
-                                   num_segments=feats.n_rows)
-    return jnp.einsum("nd,nd->n", feats.x, M[rows])
+    return kernels.score_random(feats, mapped, block_static, coefs, proj,
+                                n_codes, d_global, dtype)
 
 
 def _score_mf(sdata, params, dtype, static):
     row_mapped, col_mapped = sdata
-    rf, cf = (p.astype(dtype) for p in params)
-    k = rf.shape[-1]
-    rf = jnp.vstack([rf, jnp.zeros((1, k), dtype)])
-    cf = jnp.vstack([cf, jnp.zeros((1, k), dtype)])
-    rr = jnp.where(row_mapped >= 0, row_mapped, rf.shape[0] - 1)
-    cc = jnp.where(col_mapped >= 0, col_mapped, cf.shape[0] - 1)
-    return jnp.sum(rf[rr] * cf[cc], axis=-1)
+    rf, cf = params
+    return kernels.score_mf(row_mapped, col_mapped, rf, cf, dtype)
+
+
+def _score_random_matrix(sdata, params, dtype, static):
+    """Random effect whose entity matrix arrives pre-assembled (loaded
+    RandomEffectModelSnapshot): params IS M[n_codes + 1, d_global]."""
+    feats, mapped = sdata
+    return kernels.score_random_with_matrix(feats, mapped,
+                                            params.astype(dtype))
 
 
 class DeviceGameScorer:
@@ -142,6 +137,19 @@ class DeviceGameScorer:
                 self._kinds.append((name, "mf"))
                 self._sdata.append((row_mapped, col_mapped))
                 self._static.append(None)
+            elif kernels.is_re_snapshot(m):
+                # Loaded random-effect snapshot: entity matrix already
+                # assembled in global space (io/model_io.py). Oversize
+                # matrices must reject HERE (constructor contract), not
+                # at the later _params_of densification.
+                kernels.check_snapshot_densifiable(m, self.dtype)
+                feats = features_to_device(
+                    data.feature_shards[m.feature_shard_id], dtype=dtype)
+                mapped = jnp.asarray(_mapped_codes(
+                    data, m.random_effect_type, m.vocabulary))
+                self._kinds.append((name, "random_matrix"))
+                self._sdata.append((feats, mapped))
+                self._static.append(None)
             else:
                 raise TypeError(
                     f"coordinate {name!r}: cannot device-score "
@@ -157,7 +165,8 @@ class DeviceGameScorer:
             for kind, sdata, params, static in zip(
                     kinds, sdata_all, params_all, statics):
                 fn = {"fixed": _score_fixed, "random": _score_random,
-                      "mf": _score_mf}[kind]
+                      "mf": _score_mf,
+                      "random_matrix": _score_random_matrix}[kind]
                 total = total + fn(sdata, params, dt, static)
             return total
 
@@ -175,6 +184,11 @@ class DeviceGameScorer:
                         else jnp.asarray(re_model.projection.matrix))
                 out.append((tuple(jnp.asarray(c)
                                   for c in re_model.local_coefs), proj))
+            elif kind == "random_matrix":
+                from photon_ml_tpu.data.device_feed import chunked_device_put
+
+                out.append(chunked_device_put(
+                    kernels.snapshot_dense_matrix(m, self.dtype)))
             else:
                 out.append((m.row_factors, m.col_factors))
         return tuple(out)

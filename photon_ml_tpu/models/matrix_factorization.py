@@ -43,10 +43,10 @@ class MatrixFactorizationModel:
         return np.asarray(self.score(data))
 
     def _codes(self, data, effect_type, vocab) -> Array:
+        from photon_ml_tpu.utils.vocab import vocab_code_lookup
+
         col = data.id_columns[effect_type]
-        idx = {str(n): i for i, n in enumerate(vocab)}
-        mapped = np.asarray([idx.get(str(n), -1) for n in col.vocabulary],
-                            np.int32)
+        mapped = vocab_code_lookup(vocab, col.vocabulary).astype(np.int32)
         return jnp.asarray(mapped[col.codes])
 
     @classmethod

@@ -113,9 +113,9 @@ class RandomEffectModel:
 
     def _vocab_lookup(self, other_vocab: np.ndarray) -> np.ndarray:
         """For each name in other_vocab, this model's code or -1."""
-        idx = {str(n): i for i, n in enumerate(self.vocabulary)}
-        return np.asarray([idx.get(str(n), -1) for n in other_vocab],
-                          np.int64)
+        from photon_ml_tpu.utils.vocab import vocab_code_lookup
+
+        return vocab_code_lookup(self.vocabulary, other_vocab)
 
     @classmethod
     def zeros_like_dataset(cls, ds, dtype=jnp.float32) -> "RandomEffectModel":

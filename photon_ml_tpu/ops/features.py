@@ -966,6 +966,36 @@ def csr_from_scipy(mat, n_features: int | None = None, pad_to: int | None = None
     )
 
 
+def padded_csr_arrays(mat, n_rows_pad: int, nnz_pad: int,
+                      value_dtype=np.float32):
+    """Host-side CSR -> padded expanded-CSR triplet
+    ``(values[nnz_pad], col_ids[nnz_pad], row_ids[nnz_pad])`` (numpy).
+
+    The serving engine's featureization step: a request's scipy CSR is
+    flattened into the static bucket shape ``(n_rows_pad, nnz_pad)``
+    BEFORE upload, so every H2D transfer and every compiled executable
+    sees identical shapes. Pad entries carry value 0 at (row 0, col 0) —
+    they contribute nothing to any product — and rows in
+    [mat.shape[0], n_rows_pad) simply have no entries, so padded rows
+    score exactly 0 (CSRFeatures' existing padding convention).
+    """
+    import scipy.sparse as sp
+
+    csr = mat.tocsr() if sp.issparse(mat) else sp.csr_matrix(mat)
+    if csr.shape[0] > n_rows_pad:
+        raise ValueError(f"{csr.shape[0]} rows > n_rows_pad={n_rows_pad}")
+    if csr.nnz > nnz_pad:
+        raise ValueError(f"nnz={csr.nnz} > nnz_pad={nnz_pad}")
+    values = np.zeros(nnz_pad, dtype=value_dtype)
+    col_ids = np.zeros(nnz_pad, dtype=np.int32)
+    row_ids = np.zeros(nnz_pad, dtype=np.int32)
+    values[:csr.nnz] = csr.data
+    col_ids[:csr.nnz] = csr.indices
+    row_ids[:csr.nnz] = np.repeat(
+        np.arange(csr.shape[0], dtype=np.int32), np.diff(csr.indptr))
+    return values, col_ids, row_ids
+
+
 DENSE_DENSITY_THRESHOLD = 0.2
 
 

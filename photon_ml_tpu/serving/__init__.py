@@ -1,0 +1,34 @@
+"""Streaming GAME serving engine.
+
+``DeviceGameScorer`` (models/device_scoring.py) freezes ONE dataset at
+construction — the right tool for re-scoring a fixed validation set as the
+model changes. This package is the inverse production shape: the MODEL is
+frozen and device-resident, while request data varies per call
+(reference: cli/game/scoring/Driver.scala as a first-class serving path).
+Requests are padded into a small ladder of static shape buckets so XLA
+compiles a handful of executables held in an explicit cache; see
+docs/SCALE.md §Serving.
+
+Imports are lazy (PEP 562): ``serving.kernels`` is shared with
+``models.device_scoring``, and eager engine imports here would cycle
+through the model hierarchy.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "BucketLadder": "photon_ml_tpu.serving.buckets",
+    "StreamingGameScorer": "photon_ml_tpu.serving.engine",
+    "ExecutableCache": "photon_ml_tpu.serving.engine",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

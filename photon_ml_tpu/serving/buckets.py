@@ -1,0 +1,71 @@
+"""Static-shape bucket ladder for request padding.
+
+XLA compiles one executable per input shape, so serving arbitrary request
+sizes naively means one compilation per distinct (rows, nnz) — minutes of
+compile for milliseconds of scoring. The ladder quantizes both axes to
+powers of two (ALX's static-shape padded-batch recipe, PAPERS.md): any
+request lands in one of ~log2(max_rows) x log2(max_width) buckets, so the
+executable population is small, enumerable, and warm after a handful of
+requests. Padding waste is bounded by 2x per axis (amortized ~1.5x) and is
+reported by the engine's stats so the trade stays visible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """Quantizes (rows, nnz) request shapes to static compile buckets.
+
+    - rows: next power of two in [min_rows, max_rows]; requests beyond
+      max_rows are split by the engine, so max_rows is also the
+      micro-batch packing ceiling.
+    - nnz (per feature shard): quantized via the per-row width
+      ceil(nnz / rows_bucket) -> next power of two >= 1, so the nnz
+      bucket is always a rows_bucket multiple and a zero-nnz request
+      still gets a valid (all-padding) CSR block.
+    """
+
+    min_rows: int = 16
+    max_rows: int = 4096
+
+    def __post_init__(self):
+        if self.min_rows < 1 or self.max_rows < self.min_rows:
+            raise ValueError(
+                f"invalid ladder bounds [{self.min_rows}, {self.max_rows}]")
+
+    def rows_bucket(self, n_rows: int) -> int:
+        if n_rows > self.max_rows:
+            raise ValueError(
+                f"request has {n_rows} rows > max_rows={self.max_rows}; "
+                "split it (the engine does this automatically)")
+        return min(self.max_rows, max(self.min_rows, _next_pow2(n_rows)))
+
+    def nnz_bucket(self, nnz: int, rows_bucket: int) -> int:
+        width = -(-int(nnz) // rows_bucket) if nnz > 0 else 1
+        return rows_bucket * _next_pow2(max(1, width))
+
+    def bucket_shape(self, n_rows: int,
+                     nnz_by_shard: Tuple[int, ...]) -> Tuple:
+        """(rows_bucket, (nnz_bucket, ...)) — the shape part of a compile
+        key. Shard order must be fixed by the caller (the engine uses its
+        frozen shard order)."""
+        rb = self.rows_bucket(n_rows)
+        return (rb, tuple(self.nnz_bucket(z, rb) for z in nnz_by_shard))
+
+    def num_row_buckets(self) -> int:
+        """Distinct row buckets the ladder can emit (nnz buckets multiply
+        on top, one factor of <= log2(max width) per shard)."""
+        lo = self.rows_bucket(1)
+        count, b = 1, lo
+        while b < self.max_rows:
+            b *= 2
+            count += 1
+        return count

@@ -1,0 +1,435 @@
+"""Streaming GAME scoring engine: frozen device-resident model, varying
+request data, static-shape bucket dispatch.
+
+The inverse of ``DeviceGameScorer`` (which freezes one DATASET and varies
+the model): here the model's parameters are uploaded once at construction
+and stay in HBM — fixed-effect coefficient vectors, PRE-ASSEMBLED
+random-effect entity matrices (the per-dispatch block scatter of the
+training-time scorer is hoisted to upload time, since a serving model's
+coefficients never change), and MF factor tables. Every request then
+ships only its own payload: padded CSR feature blocks plus mapped entity
+codes.
+
+Three mechanisms keep the request path fast (Snap ML's hierarchical
+batching + ALX's static-shape padded execution, PAPERS.md):
+
+- **bucket ladder** (buckets.py): request shapes quantize to powers of
+  two, so XLA compiles a handful of executables held in an explicit
+  ``ExecutableCache`` keyed by (bucket shape, model structure, dtype);
+- **micro-batching**: ``score_many`` packs small requests into one
+  device dispatch and scatters results back per request;
+- **pipelining**: ``score_stream`` keeps ``pipeline_depth`` dispatches
+  in flight (``InFlightWindow``), so host featureization + code mapping
+  of batch k+1 overlaps the device execution of batch k, and uploads
+  ride ``chunked_device_put`` (data/device_feed.py).
+
+Padded rows cannot leak: CSR pad entries carry value 0, padded code slots
+carry -1 (the unknown-entity zero row), and results are sliced to the
+real row count before they leave the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.device_feed import InFlightWindow, chunked_device_put
+from photon_ml_tpu.models.fixed_effect import FixedEffectModel
+from photon_ml_tpu.models.game_model import GameModel
+from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
+from photon_ml_tpu.models.random_effect import RandomEffectModel
+from photon_ml_tpu.ops.features import CSRFeatures, padded_csr_arrays
+from photon_ml_tpu.serving import kernels
+from photon_ml_tpu.serving.buckets import BucketLadder
+from photon_ml_tpu.utils.vocab import SortedVocab
+
+Array = jax.Array
+
+
+class ExecutableCache:
+    """Explicit compile cache: key -> callable, with an honest build
+    counter. Keys are (bucket shape, model structure fingerprint, dtype);
+    each entry wraps its own ``jax.jit`` and is only ever called at its
+    bucket's shapes, so ``compilations`` equals the number of distinct
+    executables XLA built."""
+
+    def __init__(self):
+        self._entries: Dict[Tuple, Callable] = {}
+        self.compilations = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def keys(self):
+        return self._entries.keys()
+
+    def get_or_build(self, key: Tuple, build: Callable[[], Callable]):
+        fn = self._entries.get(key)
+        if fn is None:
+            fn = self._entries[key] = build()
+            self.compilations += 1
+        return fn
+
+
+@dataclasses.dataclass(frozen=True)
+class _SubSpec:
+    """Static per-sub-model serving structure (params live separately)."""
+
+    name: str
+    kind: str  # "fixed" | "random" | "mf"
+    shard_id: Optional[str]  # feature shard consumed (None for mf)
+    effect_types: Tuple[str, ...]  # id columns consumed ((), 1, or 2)
+    vocabs: Tuple[SortedVocab, ...]  # model vocab per effect type
+
+
+@dataclasses.dataclass
+class _HostRequest:
+    """One featureized request: host-side, unpadded."""
+
+    n_rows: int
+    shards: Dict[str, sp.csr_matrix]
+    codes: Tuple[Tuple[np.ndarray, ...], ...]  # per sub, per effect type
+
+
+class StreamingGameScorer:
+    """Scores arbitrary GameDatasets against ONE frozen GameModel.
+
+    ``dtype`` is the compute/result dtype (f32 for serving; f64 under
+    x64 for parity tests). Construction uploads and pre-assembles all
+    model state; no per-request work touches model parameters again.
+    """
+
+    def __init__(self, model: GameModel, dtype=jnp.float32,
+                 ladder: Optional[BucketLadder] = None,
+                 pipeline_depth: int = 2):
+        self.dtype = np.dtype(jnp.dtype(dtype))
+        self.ladder = ladder if ladder is not None else BucketLadder()
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._subs: List[_SubSpec] = []
+        self._params: List = []  # device-resident, aligned with _subs
+        self._shards: Dict[str, int] = {}  # shard id -> n_features
+        self._stats = {"dispatches": 0, "requests": 0, "rows_scored": 0,
+                       "rows_padded": 0, "nnz_scored": 0, "nnz_padded": 0}
+        self.cache = ExecutableCache()
+
+        dt = jnp.dtype(dtype)
+        for name, m in model.models.items():
+            re_model: Optional[RandomEffectModel] = None
+            if isinstance(m, RandomEffectModel):
+                re_model = m
+            elif isinstance(getattr(m, "latent", None), RandomEffectModel):
+                re_model = m.latent  # FactoredRandomEffectModel
+
+            if kernels.is_re_snapshot(m):
+                # Loaded-from-disk random effect: the entity matrix is
+                # ALREADY assembled in global space — append the unknown
+                # row and upload (chunked: entity tables can be large).
+                dense = kernels.snapshot_dense_matrix(m, dt)
+                self._register_shard(name, m.feature_shard_id,
+                                     dense.shape[1])
+                self._subs.append(_SubSpec(
+                    name, "random", m.feature_shard_id,
+                    (m.random_effect_type,),
+                    (SortedVocab.build(m.vocabulary),)))
+                self._params.append(chunked_device_put(dense, dt))
+                continue
+
+            if isinstance(m, FixedEffectModel):
+                w = jnp.asarray(np.asarray(m.glm.coefficients.means), dt)
+                self._register_shard(name, m.feature_shard_id, w.shape[0])
+                self._subs.append(_SubSpec(name, "fixed",
+                                           m.feature_shard_id, (), ()))
+                self._params.append(w)
+            elif re_model is not None:
+                self._register_shard(name, re_model.feature_shard_id,
+                                     re_model.num_global_features)
+                block_static = tuple(
+                    (jnp.asarray(np.asarray(codes, np.int32)),
+                     jnp.asarray(np.asarray(fidx), jnp.int32))
+                    for codes, fidx in zip(re_model.entity_codes,
+                                           re_model.feat_idx))
+                coefs = tuple(jnp.asarray(c) for c in re_model.local_coefs)
+                proj = (None if re_model.projection is None
+                        else jnp.asarray(re_model.projection.matrix))
+                # Assemble ONCE: the serving model is frozen, so the
+                # entity matrix is model state, not per-call work.
+                M = kernels.assemble_re_matrix(
+                    block_static, coefs, proj,
+                    len(re_model.vocabulary),
+                    re_model.num_global_features, dt)
+                self._subs.append(_SubSpec(
+                    name, "random", re_model.feature_shard_id,
+                    (re_model.random_effect_type,),
+                    (SortedVocab.build(re_model.vocabulary),)))
+                self._params.append(M)
+            elif isinstance(m, MatrixFactorizationModel):
+                self._subs.append(_SubSpec(
+                    name, "mf", None,
+                    (m.row_effect_type, m.col_effect_type),
+                    (SortedVocab.build(m.row_vocabulary),
+                     SortedVocab.build(m.col_vocabulary))))
+                self._params.append((jnp.asarray(m.row_factors, dt),
+                                     jnp.asarray(m.col_factors, dt)))
+            else:
+                raise TypeError(f"coordinate {name!r}: cannot device-score "
+                                f"{type(m).__name__}")
+        self._params = tuple(self._params)
+        self._shard_order = tuple(self._shards)
+        self._structure_key = (
+            tuple((s.kind, s.shard_id, s.effect_types) for s in self._subs),
+            tuple(sorted(self._shards.items())), str(self.dtype))
+
+    def _register_shard(self, name: str, shard_id: str, d: int) -> None:
+        prev = self._shards.setdefault(shard_id, int(d))
+        if prev != d:
+            raise ValueError(
+                f"coordinate {name!r} expects shard {shard_id!r} with "
+                f"{d} features but another coordinate registered {prev}")
+
+    # -- host-side featureization -----------------------------------------
+
+    def _featureize(self, data) -> _HostRequest:
+        """GameDataset rows -> per-shard CSR + per-sub mapped model codes
+        (the host half of a request; pure numpy/scipy, overlappable with
+        in-flight device work)."""
+        shards = {}
+        for sid, d in self._shards.items():
+            mat = data.feature_shards.get(sid)
+            if mat is None:
+                raise KeyError(f"request is missing feature shard {sid!r} "
+                               f"(has {sorted(data.feature_shards)})")
+            csr = mat.tocsr()
+            if csr.shape[1] != d:
+                raise ValueError(
+                    f"shard {sid!r}: request has {csr.shape[1]} features, "
+                    f"model expects {d}")
+            shards[sid] = csr
+        codes = []
+        for spec in self._subs:
+            per_effect = []
+            for etype, vocab in zip(spec.effect_types, spec.vocabs):
+                col = data.id_columns.get(etype)
+                if col is None:
+                    raise KeyError(
+                        f"request is missing id column {etype!r} "
+                        f"(has {sorted(data.id_columns)})")
+                lookup = vocab.codes_of(col.vocabulary).astype(np.int32)
+                per_effect.append(lookup[col.codes])
+            codes.append(tuple(per_effect))
+        return _HostRequest(int(data.num_rows), shards, tuple(codes))
+
+    def _assemble(self, group: List[_HostRequest]):
+        """Pack a group of requests into one padded bucket batch.
+
+        Returns (cache key, host argument pytree, per-request row
+        splits). Row ids shift by each request's offset, so one
+        segment-sum dispatch serves the whole group and results scatter
+        back by slicing."""
+        n_total = sum(r.n_rows for r in group)
+        rows_b = self.ladder.rows_bucket(n_total)
+        shard_args = []
+        nnz_buckets = []
+        nnz_total = 0
+        for sid in self._shard_order:
+            mats = [r.shards[sid] for r in group]
+            csr = mats[0] if len(mats) == 1 else sp.vstack(mats,
+                                                           format="csr")
+            nnz_b = self.ladder.nnz_bucket(csr.nnz, rows_b)
+            shard_args.append(padded_csr_arrays(csr, rows_b, nnz_b,
+                                                value_dtype=self.dtype))
+            nnz_buckets.append(nnz_b)
+            nnz_total += int(csr.nnz)
+        code_args = []
+        for i, spec in enumerate(self._subs):
+            per_effect = []
+            for j in range(len(spec.effect_types)):
+                padded = np.full(rows_b, -1, np.int32)
+                off = 0
+                for r in group:
+                    padded[off:off + r.n_rows] = r.codes[i][j]
+                    off += r.n_rows
+                per_effect.append(padded)
+            code_args.append(tuple(per_effect))
+        key = ((rows_b, tuple(nnz_buckets)), self._structure_key)
+        splits = np.cumsum([r.n_rows for r in group])[:-1]
+        self._stats["requests"] += len(group)
+        self._stats["rows_scored"] += n_total
+        self._stats["rows_padded"] += rows_b
+        self._stats["nnz_scored"] += nnz_total
+        self._stats["nnz_padded"] += sum(nnz_buckets)
+        return key, (tuple(shard_args), tuple(code_args)), splits
+
+    # -- device dispatch ---------------------------------------------------
+
+    def _build_fn(self, rows_b: int, nnz_by_shard: Tuple[int, ...]):
+        subs = self._subs
+        shard_order = self._shard_order
+        shard_dims = dict(self._shards)
+        dt = jnp.dtype(self.dtype)
+
+        def score_bucket(shard_args, code_args, params):
+            feats = {
+                sid: CSRFeatures(v, c, r, rows_b, shard_dims[sid])
+                for sid, (v, c, r) in zip(shard_order, shard_args)}
+            total = jnp.zeros((rows_b,), dt)
+            for spec, codes, p in zip(subs, code_args, params):
+                if spec.kind == "fixed":
+                    total = total + kernels.score_fixed(
+                        feats[spec.shard_id], p, dt)
+                elif spec.kind == "random":
+                    total = total + kernels.score_random_with_matrix(
+                        feats[spec.shard_id], codes[0], p)
+                else:
+                    total = total + kernels.score_mf(
+                        codes[0], codes[1], p[0], p[1], dt)
+            return total
+
+        return jax.jit(score_bucket)
+
+    def _dispatch(self, key, host_args) -> Array:
+        """Upload one padded batch and launch its bucket executable
+        (async — the returned device array is a future)."""
+        fn = self.cache.get_or_build(
+            key, lambda: self._build_fn(*key[0]))
+        dev = jax.tree.map(lambda a: chunked_device_put(a), host_args,
+                           is_leaf=lambda x: isinstance(x, np.ndarray))
+        self._stats["dispatches"] += 1
+        return fn(*dev, self._params)
+
+    # -- public scoring API ------------------------------------------------
+
+    def _split(self, data) -> List:
+        """Oversized requests split into ladder-sized row slices."""
+        n = data.num_rows
+        if n <= self.ladder.max_rows:
+            return [data]
+        return [data.subset(np.arange(a, min(a + self.ladder.max_rows, n)))
+                for a in range(0, n, self.ladder.max_rows)]
+
+    def score(self, data) -> np.ndarray:
+        """Score one request dataset; returns host f[n_rows] (model
+        margins, no offsets — same contract as GameModel.score).
+        Oversized requests split AND pipeline (score_stream), so piece
+        k+1's featureization overlaps piece k's dispatch."""
+        return next(self.score_stream([data]))
+
+    def score_many(self, datasets) -> List[np.ndarray]:
+        """Micro-batch a list of small requests: consecutive requests
+        pack into shared dispatches (combined rows <= ladder.max_rows),
+        results scatter back per request. Dispatches are pipelined."""
+        datasets = list(datasets)
+        results: List[Optional[np.ndarray]] = [None] * len(datasets)
+        groups: List[List[int]] = []
+        rows = 0
+        for i, ds in enumerate(datasets):
+            n = ds.num_rows
+            if n == 0:
+                results[i] = np.zeros(0, self.dtype)
+                continue
+            if n > self.ladder.max_rows:
+                groups.append([i])  # handled via score() (splitting)
+                continue
+            if groups and rows + n <= self.ladder.max_rows \
+                    and datasets[groups[-1][-1]].num_rows \
+                    <= self.ladder.max_rows:
+                groups[-1].append(i)
+                rows += n
+            else:
+                groups.append([i])
+                rows = n
+        win = InFlightWindow(self.pipeline_depth)
+
+        def settle(done):
+            out, idxs, splits = done
+            host = np.asarray(out)
+            for idx, chunk in zip(idxs, np.split(
+                    host[:sum(datasets[i].num_rows for i in idxs)],
+                    splits)):
+                results[idx] = chunk
+
+        for g in groups:
+            if len(g) == 1 and datasets[g[0]].num_rows \
+                    > self.ladder.max_rows:
+                results[g[0]] = self.score(datasets[g[0]])
+                continue
+            reqs = [self._featureize(datasets[i]) for i in g]
+            key, args, splits = self._assemble(reqs)
+            out = self._dispatch(key, args)
+            done = win.push((out, g, splits), ready=out)
+            if done is not None:
+                settle(done)
+        for done in win.drain():
+            settle(done)
+        return results
+
+    def score_stream(self, datasets: Iterable) -> Iterator[np.ndarray]:
+        """Pipelined scoring of a stream of request datasets: yields one
+        score vector per input, in order, while keeping up to
+        ``pipeline_depth`` device dispatches in flight — host
+        featureization of batch k+1 overlaps the device execution of
+        batch k."""
+        win = InFlightWindow(self.pipeline_depth)
+        pending: List[np.ndarray] = []
+
+        def settle(done):
+            out, n_real, last = done
+            pending.append(np.asarray(out)[:n_real])
+            if not last:
+                return None
+            res = (pending[0] if len(pending) == 1
+                   else np.concatenate(pending))
+            pending.clear()
+            return res
+
+        for ds in datasets:
+            if ds.num_rows == 0:
+                # Flush in-flight work so output order is preserved.
+                for done in win.drain():
+                    res = settle(done)
+                    if res is not None:
+                        yield res
+                yield np.zeros(0, self.dtype)
+                continue
+            pieces = self._split(ds)
+            for pi, piece in enumerate(pieces):
+                key, args, _ = self._assemble([self._featureize(piece)])
+                out = self._dispatch(key, args)
+                done = win.push(
+                    (out, piece.num_rows, pi == len(pieces) - 1),
+                    ready=out)
+                if done is not None:
+                    res = settle(done)
+                    if res is not None:
+                        yield res
+        for done in win.drain():
+            res = settle(done)
+            if res is not None:
+                yield res
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def shard_order(self) -> Tuple[str, ...]:
+        """Feature-shard order used in bucket keys (registration order)."""
+        return self._shard_order
+
+    def cache_info(self) -> dict:
+        return {"entries": len(self.cache),
+                "compilations": self.cache.compilations,
+                "bucket_shapes": sorted(k[0] for k in self.cache.keys())}
+
+    def stats(self) -> dict:
+        s = dict(self._stats)
+        s["padding_waste_rows"] = (
+            1.0 - s["rows_scored"] / s["rows_padded"]
+            if s["rows_padded"] else 0.0)
+        s["padding_waste_nnz"] = (
+            1.0 - s["nnz_scored"] / s["nnz_padded"]
+            if s["nnz_padded"] else 0.0)
+        s.update(self.cache_info())
+        return s

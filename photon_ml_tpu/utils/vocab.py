@@ -1,0 +1,56 @@
+"""Vectorized entity-vocabulary code lookup.
+
+Every scoring path that joins a dataset's entity ids against a model's
+vocabulary used to build a ``{str(name): code}`` python dict per call —
+O(vocab) interpreted work with a ``str()`` per entry, sitting directly on
+the request path (models/device_scoring.py, random_effect.py,
+matrix_factorization.py). The replacement is one ``np.argsort`` over the
+model vocab plus a ``np.searchsorted`` per query batch: all C loops, and
+the serving engine amortizes the sort across requests by passing a
+prebuilt ``SortedVocab``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SortedVocab:
+    """A model vocabulary presorted for repeated searchsorted lookups.
+
+    ``codes_of(names)`` returns, per name, the index of that name in the
+    ORIGINAL vocab order (the model's code space), or -1 when absent —
+    the reference's missing-join semantics (unknown entities score 0).
+    """
+
+    sorted_names: np.ndarray  # unicode, ascending
+    order: np.ndarray  # i64: position in sorted_names -> original code
+
+    @classmethod
+    def build(cls, vocab) -> "SortedVocab":
+        v = np.asarray(vocab)
+        v = v.astype(str) if v.dtype.kind != "U" else v
+        order = np.argsort(v, kind="stable")
+        return cls(sorted_names=v[order], order=order.astype(np.int64))
+
+    def codes_of(self, names) -> np.ndarray:
+        q = np.asarray(names)
+        q = q.astype(str) if q.dtype.kind != "U" else q
+        if self.sorted_names.size == 0 or q.size == 0:
+            return np.full(q.shape, -1, np.int64)
+        pos = np.searchsorted(self.sorted_names, q)
+        pos = np.minimum(pos, len(self.sorted_names) - 1)
+        return np.where(self.sorted_names[pos] == q,
+                        self.order[pos], -1)
+
+
+def vocab_code_lookup(vocab, names) -> np.ndarray:
+    """For each name in ``names``: its code (index) in ``vocab``, or -1.
+
+    One-shot form of ``SortedVocab`` (sorts per call); equivalent to the
+    old dict-based ``{str(n): i}`` lookup for duplicate-free vocabularies.
+    """
+    return SortedVocab.build(vocab).codes_of(names)

@@ -1,0 +1,323 @@
+"""Streaming serving engine: score parity with the host path, bucket /
+compile-cache discipline, micro-batch scatter, padded-row isolation, and
+the vectorized vocab join. Reference scoring semantics are the same as
+DeviceGameScorer's (ml/model/*Model.scala score paths); what is under test
+here is the REQUEST-side machinery: shape bucketing, the executable cache,
+and the featureize->H2D->score pipeline."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    LogisticRegressionModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serving import (
+    BucketLadder,
+    ExecutableCache,
+    StreamingGameScorer,
+)
+from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.vocab import SortedVocab, vocab_code_lookup
+
+DT = jnp.float64
+
+
+def _dataset(rng, n=60, d=6, n_users=7, n_items=5, user_names=None):
+    x = rng.normal(0, 1, (n, d))
+    x[:, -1] = 1.0
+    if user_names is None:
+        users = rng.integers(0, n_users, n).astype(str)
+    else:
+        users = np.asarray(user_names)
+    items = rng.integers(0, n_items, n).astype(str)
+    user_x = sp.csr_matrix(np.hstack(
+        [rng.normal(0, 1, (n, 2)), np.ones((n, 1))]))
+    return GameDataset.build(
+        responses=(rng.random(n) < 0.5).astype(float),
+        feature_shards={"global": sp.csr_matrix(x), "user": user_x},
+        ids={"userId": users, "itemId": items})
+
+
+def _game_model(rng, train):
+    ds = build_random_effect_dataset(
+        train, RandomEffectDataConfiguration("userId", "user"),
+        intercept_col=2)
+    re = RandomEffectModel.zeros_like_dataset(ds, dtype=DT)
+    re = re.with_coefs([jnp.asarray(rng.normal(0, 1, np.asarray(c).shape))
+                        for c in re.local_coefs])
+    fe = FixedEffectModel(
+        LogisticRegressionModel(Coefficients(
+            jnp.asarray(rng.normal(0, 1, 6)))), "global")
+    mf = MatrixFactorizationModel(
+        "userId", "itemId",
+        jnp.asarray(rng.normal(0, 1, (7, 3))),
+        jnp.asarray(rng.normal(0, 1, (5, 3))),
+        np.unique(train.id_columns["userId"].vocabulary),
+        np.unique(train.id_columns["itemId"].vocabulary))
+    return GameModel({"fixed": fe, "perUser": re, "mf": mf},
+                     TaskType.LOGISTIC_REGRESSION)
+
+
+@pytest.fixture
+def engine_and_model(rng):
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    eng = StreamingGameScorer(gm, dtype=DT,
+                              ladder=BucketLadder(min_rows=8, max_rows=64))
+    return eng, gm
+
+
+# -- parity ----------------------------------------------------------------
+
+@pytest.mark.needs_f64
+def test_engine_matches_host_scoring(engine_and_model, rng):
+    eng, gm = engine_and_model
+    req = _dataset(np.random.default_rng(5), n=37)
+    np.testing.assert_allclose(eng.score(req), gm.score(req),
+                               rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.needs_f64
+def test_engine_splits_oversized_requests(engine_and_model):
+    eng, gm = engine_and_model
+    req = _dataset(np.random.default_rng(7), n=150)  # > max_rows=64
+    np.testing.assert_allclose(eng.score(req), gm.score(req),
+                               rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.needs_f64
+def test_engine_micro_batch_scatters_per_request(engine_and_model):
+    eng, gm = engine_and_model
+    reqs = [_dataset(np.random.default_rng(i), n=k)
+            for i, k in enumerate([5, 9, 17, 3, 70, 1])]
+    outs = eng.score_many(reqs)
+    assert len(outs) == len(reqs)
+    for r, o in zip(reqs, outs):
+        assert len(o) == r.num_rows
+        np.testing.assert_allclose(o, gm.score(r), rtol=1e-10, atol=1e-10)
+    # small requests genuinely shared dispatches
+    assert eng.stats()["dispatches"] < len(reqs) + 1
+
+
+@pytest.mark.needs_f64
+def test_engine_stream_order_and_parity(engine_and_model):
+    eng, gm = engine_and_model
+    reqs = [_dataset(np.random.default_rng(10 + i), n=k)
+            for i, k in enumerate([12, 33, 64, 2, 150])]
+    outs = list(eng.score_stream(iter(reqs)))
+    assert len(outs) == len(reqs)
+    for r, o in zip(reqs, outs):
+        np.testing.assert_allclose(o, gm.score(r), rtol=1e-10, atol=1e-10)
+
+
+# -- edge cases ------------------------------------------------------------
+
+def test_all_unknown_entities_score_re_and_mf_zero(rng):
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    # Drop the fixed effect so every score must be exactly 0.
+    gm_re = GameModel({k: v for k, v in gm.models.items() if k != "fixed"},
+                      TaskType.LOGISTIC_REGRESSION)
+    eng = StreamingGameScorer(gm_re, dtype=DT,
+                              ladder=BucketLadder(min_rows=8, max_rows=64))
+    req = _dataset(np.random.default_rng(3), n=20,
+                   user_names=["zz_unknown"] * 20)
+    # unknown item ids too
+    req = GameDataset.build(
+        responses=req.responses,
+        feature_shards=dict(req.feature_shards),
+        ids={"userId": np.asarray(["zz_unknown"] * 20),
+             "itemId": np.asarray(["qq_missing"] * 20)})
+    np.testing.assert_allclose(eng.score(req), 0.0)
+    np.testing.assert_allclose(gm_re.score(req), 0.0)
+
+
+def test_zero_nnz_batch_scores_zero_fixed(rng):
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    eng = StreamingGameScorer(gm, dtype=DT,
+                              ladder=BucketLadder(min_rows=8, max_rows=64))
+    n = 11
+    req = GameDataset.build(
+        responses=np.zeros(n),
+        feature_shards={"global": sp.csr_matrix((n, 6)),
+                        "user": sp.csr_matrix((n, 3))},
+        ids={"userId": np.asarray(["zz"] * n),
+             "itemId": np.asarray(["qq"] * n)})
+    # all-zero features + unknown entities -> exactly zero margins
+    np.testing.assert_allclose(eng.score(req), 0.0)
+    np.testing.assert_allclose(gm.score(req), 0.0)
+
+
+def test_empty_request_returns_empty_without_dispatch(engine_and_model):
+    eng, _ = engine_and_model
+    empty = GameDataset.build(
+        responses=np.zeros(0),
+        feature_shards={"global": sp.csr_matrix((0, 6)),
+                        "user": sp.csr_matrix((0, 3))},
+        ids={"userId": np.asarray([], str), "itemId": np.asarray([], str)})
+    before = eng.stats()["dispatches"]
+    assert len(eng.score(empty)) == 0
+    assert eng.stats()["dispatches"] == before
+    outs = list(eng.score_stream([empty]))
+    assert len(outs) == 1 and len(outs[0]) == 0
+
+
+@pytest.mark.needs_f64
+def test_bucket_boundary_padding_does_not_leak(rng):
+    """Requests at an exact bucket size and one row over: scores must be
+    identical to the host path row-for-row, and the evaluator metric over
+    streamed scores must equal the full-batch metric (padded rows never
+    reach scores or metrics)."""
+    from photon_ml_tpu.evaluation import build_evaluator
+
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    eng = StreamingGameScorer(gm, dtype=DT,
+                              ladder=BucketLadder(min_rows=8, max_rows=64))
+    for n in (8, 9, 16, 17, 64):
+        req = _dataset(np.random.default_rng(n), n=n)
+        got = eng.score(req)
+        assert got.shape == (n,)
+        np.testing.assert_allclose(got, gm.score(req),
+                                   rtol=1e-10, atol=1e-10)
+    # metric parity: stream in 3 uneven batches vs one host pass
+    req = _dataset(np.random.default_rng(77), n=50)
+    parts = [req.subset(np.arange(0, 13)), req.subset(np.arange(13, 45)),
+             req.subset(np.arange(45, 50))]
+    streamed = np.concatenate(list(eng.score_stream(parts)))
+    ev = build_evaluator("AUC")
+    assert ev.evaluate_dataset(streamed, req) == pytest.approx(
+        ev.evaluate_dataset(gm.score(req), req), abs=1e-12)
+
+
+# -- compile-cache discipline ---------------------------------------------
+
+def test_executable_cache_counts_builds():
+    cache = ExecutableCache()
+    built = []
+    for key in ["a", "b", "a", "a", "b", "c"]:
+        cache.get_or_build(key, lambda k=key: built.append(k) or (lambda: k))
+    assert cache.compilations == 3
+    assert len(cache) == 3
+    assert built == ["a", "b", "c"]
+
+
+def test_compile_count_bounded_by_bucket_ladder(rng):
+    """50 random-size requests compile at most (distinct buckets + 1)
+    executables, and re-scoring the same sizes compiles nothing new."""
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    ladder = BucketLadder(min_rows=8, max_rows=64)
+    eng = StreamingGameScorer(gm, dtype=DT, ladder=ladder)
+    sizes = np.random.default_rng(0).integers(1, 65, 50)
+    reqs = [_dataset(np.random.default_rng(100 + i), n=int(n))
+            for i, n in enumerate(sizes)]
+    for r in reqs:
+        eng.score(r)
+    expected_keys = set()
+    for r in reqs:
+        nnz = tuple(int(r.feature_shards[s].nnz) for s in ("global", "user"))
+        expected_keys.add(ladder.bucket_shape(r.num_rows, nnz))
+    assert eng.cache.compilations <= len(expected_keys) + 1
+    assert eng.stats()["entries"] == eng.cache.compilations
+    before = eng.cache.compilations
+    for r in reqs[:10]:
+        eng.score(r)
+    assert eng.cache.compilations == before
+
+
+def test_bucket_ladder_shapes():
+    ladder = BucketLadder(min_rows=16, max_rows=4096)
+    assert ladder.rows_bucket(1) == 16
+    assert ladder.rows_bucket(16) == 16
+    assert ladder.rows_bucket(17) == 32
+    assert ladder.rows_bucket(4096) == 4096
+    with pytest.raises(ValueError):
+        ladder.rows_bucket(4097)
+    assert ladder.nnz_bucket(0, 16) == 16  # zero-nnz stays a valid block
+    assert ladder.nnz_bucket(33, 16) == 16 * 4  # width 3 -> 4
+    assert ladder.num_row_buckets() == 9  # 16..4096
+
+
+# -- vectorized vocab join -------------------------------------------------
+
+def test_vocab_lookup_matches_dict_join(rng):
+    vocab = np.unique(
+        [f"ent{int(i)}" for i in rng.integers(0, 500, 200)])
+    rng.shuffle(vocab)  # model vocab order is NOT sorted
+    queries = np.asarray(
+        [f"ent{int(i)}" for i in rng.integers(0, 1000, 300)])
+    idx = {str(n): i for i, n in enumerate(vocab)}
+    want = np.asarray([idx.get(str(n), -1) for n in queries], np.int64)
+    got = vocab_code_lookup(vocab, queries)
+    np.testing.assert_array_equal(got, want)
+    assert (got == -1).any(), "test must cover unknown entities"
+    # prebuilt form agrees and handles empty inputs
+    sv = SortedVocab.build(vocab)
+    np.testing.assert_array_equal(sv.codes_of(queries), want)
+    assert vocab_code_lookup(vocab, np.asarray([], str)).size == 0
+    assert (vocab_code_lookup(np.asarray([], str), queries) == -1).all()
+
+
+def test_snapshot_densify_ceiling_rejects_at_construction():
+    """A loaded random-effect snapshot too large to densify must raise
+    the constructor-time TypeError contract (driver -> host fallback),
+    never attempt the allocation."""
+    from photon_ml_tpu.serving import kernels as sk
+
+    class Snap:  # duck-typed io.model_io.RandomEffectModelSnapshot
+        random_effect_type = "userId"
+        feature_shard_id = "global"
+        vocabulary = np.arange(3_000_000)
+        matrix = sp.csr_matrix((3_000_000, 200_000))
+
+    assert sk.is_re_snapshot(Snap())
+    with pytest.raises(TypeError, match="densification ceiling"):
+        sk.check_snapshot_densifiable(Snap(), np.float64)
+    gm = GameModel({"perUser": Snap()}, TaskType.LOGISTIC_REGRESSION)
+    with pytest.raises(TypeError, match="densification ceiling"):
+        StreamingGameScorer(gm, dtype=DT)
+    # comfortably-small snapshots stay densifiable
+    class Small(Snap):
+        vocabulary = np.arange(10)
+        matrix = sp.csr_matrix(np.eye(10, 6))
+
+    sk.check_snapshot_densifiable(Small(), np.float64)
+
+
+def test_engine_rejects_unsupported_submodel(rng):
+    class Exotic:
+        pass
+
+    gm = GameModel({"weird": Exotic()}, TaskType.LOGISTIC_REGRESSION)
+    with pytest.raises(TypeError, match="cannot device-score"):
+        StreamingGameScorer(gm)
+
+
+def test_engine_rejects_missing_shard_and_wrong_width(engine_and_model):
+    eng, _ = engine_and_model
+    n = 4
+    base = dict(responses=np.zeros(n),
+                ids={"userId": np.asarray(["a"] * n),
+                     "itemId": np.asarray(["b"] * n)})
+    with pytest.raises(KeyError, match="missing feature shard"):
+        eng.score(GameDataset.build(
+            feature_shards={"global": sp.csr_matrix((n, 6))}, **base))
+    with pytest.raises(ValueError, match="model expects"):
+        eng.score(GameDataset.build(
+            feature_shards={"global": sp.csr_matrix((n, 6)),
+                            "user": sp.csr_matrix((n, 99))}, **base))
