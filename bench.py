@@ -906,6 +906,7 @@ def serving_bench():
     from photon_ml_tpu.algorithm import CoordinateDescent
     from photon_ml_tpu.serving import BucketLadder, StreamingGameScorer
     from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils.tracing_guard import RetraceError
 
     try:
         cpu_cores = len(os.sched_getaffinity(0))
@@ -978,13 +979,24 @@ def serving_bench():
                     for s in sweep_engine.shard_order)
         expected.add(sweep_engine.ladder.bucket_shape(r.num_rows, nnz))
     st = sweep_engine.stats()
+    # The bound is ASSERTED through the shared tracing_guard machinery
+    # (utils/tracing_guard.py): total traces across every executable the
+    # cache ever built, not a hand-rolled build counter — an evicted-and-
+    # rebuilt bucket or an in-entry retrace both fail bound_ok.
+    try:
+        sweep_engine.cache.assert_max_retraces(
+            max_total=len(expected) + 1, per_fn=1)
+        bound_ok = True
+    except RetraceError:
+        bound_ok = False
     sweep = {
         "requests": len(reqs),
         "row_range": [int(sizes.min()), int(sizes.max())],
         "distinct_buckets": st["entries"],
         "compilations": st["compilations"],
+        "traces": st["traces"],
         "ladder_expected_buckets": len(expected),
-        "bound_ok": st["compilations"] <= len(expected) + 1,
+        "bound_ok": bound_ok,
         "padding_waste_rows": round(st["padding_waste_rows"], 4),
         "padding_waste_nnz": round(st["padding_waste_nnz"], 4),
     }
@@ -1191,6 +1203,10 @@ def stream_bandwidth_gbps():
         z = x @ v
         return v + 1e-30 * (z @ x)
 
+    # Bench-local jit is the point here: one fresh executable, warmed then
+    # timed — never a per-request path. Accepted in jaxlint_baseline.txt
+    # rather than suppressed inline so the retrace-hazard rule keeps
+    # watching this function if it ever grows a second jit.
     f = jax.jit(lambda v: lax.fori_loop(0, reps, lambda i, v: step(v), v))
     v0 = jnp.zeros((D_FIXED,), jnp.float32)
     _sync(f(v0))

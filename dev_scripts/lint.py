@@ -53,16 +53,21 @@ def _used_names(tree: ast.AST):
             used.add(node.id)
         elif isinstance(node, ast.Constant) and isinstance(node.value, str):
             # Identifier-shaped strings count as uses: string type
-            # annotations (PEP 563 forward refs) and __all__ entries.
-            for tok in node.value.replace("[", " ").replace("]", " ").split():
+            # annotations (PEP 563 forward refs, incl. dotted forms like
+            # 'np.ndarray') and __all__ entries.
+            for tok in (node.value.replace("[", " ").replace("]", " ")
+                        .replace(".", " ").replace(",", " ").split()):
                 if tok.isidentifier():
                     used.add(tok)
     return used
 
 
-def lint_file(path: Path) -> list:
+def lint_file(path: Path, src: str = None) -> list:
+    """``src`` lets a caller that already read the file (dev_scripts/
+    jaxlint.py's shared walk) skip the second read."""
     problems = []
-    src = path.read_text()
+    if src is None:
+        src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:

@@ -31,6 +31,7 @@ from photon_ml_tpu.evaluation.evaluators import Evaluator
 from photon_ml_tpu.models.game_model import GameModel
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.types import TaskType
+from photon_ml_tpu.utils.tracing_guard import TracingGuard
 
 logger = logging.getLogger(__name__)
 
@@ -114,6 +115,11 @@ class CoordinateDescent:
         self._fused_fns = None
         self._block_fns: Dict[int, object] = {}
         self._val_scorer = None
+        # Shared retrace infrastructure (utils/tracing_guard.py): every
+        # fused executable registers here, and run() asserts the hot
+        # loop's compile-count invariant — each executable traces exactly
+        # once — instead of trusting it silently.
+        self.tracing_guard = TracingGuard()
 
     def _fused_update_fns(self):
         """One jitted function per coordinate performing the ENTIRE update —
@@ -156,6 +162,8 @@ class CoordinateDescent:
             return jax.jit(fused)
 
         self._fused_fns = {n: make(n) for n in names}
+        for n, fn in self._fused_fns.items():
+            self.tracing_guard.track(f"fused:{n}", fn)
         return self._fused_fns
 
     def _fused_block_fn(self, n_iters: int):
@@ -226,6 +234,7 @@ class CoordinateDescent:
 
         fn = jax.jit(block)
         self._block_fns[n_iters] = fn
+        self.tracing_guard.track(f"block:{n_iters}", fn)
         return fn
 
     def run(
@@ -318,6 +327,13 @@ class CoordinateDescent:
         data_args = {n: self.coordinates[n].step_data() for n in names}
         pdata_args = {n: self.coordinates[n].penalty_data() for n in names}
         params = {n: self.coordinates[n].params_of(models[n]) for n in names}
+        # Canonicalize param leaves to device arrays: checkpoint-loaded
+        # models carry host np.ndarray leaves, and np inputs key a
+        # SEPARATE pjit executable from the device arrays of steady-state
+        # calls — one silent recompile per coordinate on every resume
+        # (surfaced by tracing_guard's per_fn=1 invariant below).
+        params = {n: jax.tree.map(jnp.asarray, p)
+                  for n, p in params.items()}
         fused = self._fused_update_fns()
 
         def _sync_models():
@@ -515,6 +531,13 @@ class CoordinateDescent:
         _sync_models()
         _materialize_history()
         _materialize_pending(include_trackers=False)
+        # Hot-loop compile invariant: every fused executable (per-
+        # coordinate step fns, per-span block fns) traced exactly once
+        # this run — the runtime complement of jaxlint's retrace-hazard
+        # rule. A trip here means argument shapes/dtypes/statics drifted
+        # call-to-call and every "one dispatch" above silently paid a
+        # recompile.
+        self.tracing_guard.assert_max_retraces(per_fn=1)
         if logger.isEnabledFor(logging.INFO) and objective_history:
             logger.info("objective history: %s",
                         ["%.6f" % v for v in objective_history])

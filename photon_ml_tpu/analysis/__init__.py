@@ -1,0 +1,31 @@
+"""jaxlint: AST-based static analysis for JAX performance pitfalls.
+
+CLI front-end: dev_scripts/jaxlint.py (wired into tests.sh).
+Runtime complement: photon_ml_tpu/utils/tracing_guard.py.
+Rule catalog + examples: docs/ANALYSIS.md.
+"""
+
+from photon_ml_tpu.analysis.core import (
+    Violation,
+    analyze_modules,
+    analyze_sources,
+    apply_baseline,
+    iter_py_files,
+    load_baseline,
+    load_modules,
+    write_baseline,
+)
+from photon_ml_tpu.analysis.rules import ALL_RULES, RULE_IDS
+
+__all__ = [
+    "Violation",
+    "analyze_modules",
+    "analyze_sources",
+    "apply_baseline",
+    "iter_py_files",
+    "load_baseline",
+    "load_modules",
+    "write_baseline",
+    "ALL_RULES",
+    "RULE_IDS",
+]

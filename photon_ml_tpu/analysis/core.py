@@ -1,0 +1,539 @@
+"""jaxlint core: dependency-free AST analysis infrastructure for JAX
+pitfalls.
+
+The style gate (dev_scripts/lint.py) keeps the tree tidy; this package
+keeps it FAST — its rules target the failure modes that silently destroy
+device performance instead of correctness: per-call recompilation,
+host-device sync points on jit-reachable paths, dtype drift breaking the
+f32 parity contract (docs/F32_PARITY.md), and compile-cache-key
+instability from unordered iteration. Rules live in rules.py; this module
+owns the machinery they share:
+
+- parsing + per-module indexes (parent links, enclosing-function map,
+  import aliases, inline suppressions);
+- a project-wide fixpoint of which functions are TRACE-REACHABLE
+  (jit-decorated, passed to jit/pallas_call/lax combinators, nested in or
+  called from reachable bodies — including cross-module calls through
+  photon_ml_tpu imports);
+- the signature index of jit-wrapped entry points and their static
+  argument positions (for the retrace-hazard rule);
+- the violation/baseline model: fingerprints are line-number-free
+  (path :: rule :: scope :: normalized source line) so the checked-in
+  baseline survives unrelated edits, and the gate is "no NEW violations".
+
+Suppression syntax, on the violating line:
+    something_hazardous()  # jaxlint: disable=host-sync
+    other()  # jaxlint: disable=host-sync,dtype-drift
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# Names whose call sites trace their function-valued arguments: a function
+# passed (by name or as a lambda) into one of these has its body staged
+# into jaxpr, so host-sync rules apply inside it. Matched on the terminal
+# attribute name (jax.jit / functools.partial(jax.jit, ...) / pl.pallas_call
+# / lax.while_loop all land here).
+TRACING_CALLS = frozenset({
+    "jit", "pallas_call", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "vmap", "pmap", "grad", "value_and_grad", "jacfwd", "jacrev",
+    "hessian", "checkpoint", "remat", "custom_jvp", "custom_vjp",
+    "shard_map",
+})
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding. ``scope`` is the qualified name of the enclosing
+    function ('<module>' at top level); the fingerprint deliberately
+    excludes the line number so baselines survive unrelated edits."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    scope: str = "<module>"
+    text: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.rule}::{self.scope}::{self.text}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function-like scope (def / async def / lambda)."""
+
+    node: ast.AST
+    name: str
+    qualname: str
+    parent: Optional["FuncInfo"]
+
+
+@dataclasses.dataclass
+class ModuleSource:
+    """A parsed file plus the per-module indexes rules consume."""
+
+    path: str  # repo-relative, forward slashes
+    source: str
+    tree: ast.Module
+    lines: List[str] = dataclasses.field(default_factory=list)
+    suppressions: Dict[int, Set[str]] = dataclasses.field(
+        default_factory=dict)
+    parents: Dict[ast.AST, ast.AST] = dataclasses.field(
+        default_factory=dict)
+    functions: List[FuncInfo] = dataclasses.field(default_factory=list)
+    fn_of: Dict[ast.AST, Optional[FuncInfo]] = dataclasses.field(
+        default_factory=dict)
+    # import alias -> fully-qualified module/object name
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    numpy_aliases: Set[str] = dataclasses.field(default_factory=set)
+    jnp_aliases: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def module_name(self) -> str:
+        p = self.path[:-3] if self.path.endswith(".py") else self.path
+        p = p.replace("/", ".")
+        return p[:-len(".__init__")] if p.endswith(".__init__") else p
+
+    def scope_of(self, node: ast.AST) -> str:
+        fi = self.fn_of.get(node)
+        return fi.qualname if fi is not None else "<module>"
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return rules is not None and (rule in rules or "all" in rules)
+
+    def violation(self, node: ast.AST, rule: str, message: str
+                  ) -> Optional[Violation]:
+        line = getattr(node, "lineno", 0)
+        if self.suppressed(line, rule):
+            return None
+        text = self.lines[line - 1].strip() if 0 < line <= len(
+            self.lines) else ""
+        return Violation(self.path, line, rule, message,
+                         self.scope_of(node), text)
+
+
+def parse_module(path: str, source: str) -> Optional[ModuleSource]:
+    """Parse + index one file; returns None when the file does not parse
+    (the style gate owns syntax errors)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    mod = ModuleSource(path=path, source=source, tree=tree,
+                       lines=source.splitlines())
+    for i, line in enumerate(mod.lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            mod.suppressions[i] = {
+                r.strip() for r in m.group(1).split(",") if r.strip()}
+    _index(mod)
+    return mod
+
+
+def _index(mod: ModuleSource) -> None:
+    def visit(node, parent, fn, classname):
+        mod.parents[node] = parent
+        mod.fn_of[node] = fn
+        child_fn = fn
+        child_class = classname
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            name = getattr(node, "name", "<lambda>")
+            prefix = fn.qualname + "." if fn else ""
+            if classname and not fn:
+                prefix = classname + "."
+            info = FuncInfo(node, name, prefix + name, fn)
+            mod.functions.append(info)
+            child_fn = info
+            child_class = None
+        elif isinstance(node, ast.ClassDef):
+            child_class = (classname + "." if classname else "") + node.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                mod.imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name != "*":
+                    mod.imports[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+        for child in ast.iter_child_nodes(node):
+            visit(child, node, child_fn, child_class)
+
+    visit(mod.tree, None, None, None)
+    for alias, target in mod.imports.items():
+        if target == "numpy":
+            mod.numpy_aliases.add(alias)
+        elif target == "jax.numpy":
+            mod.jnp_aliases.add(alias)
+
+
+def call_name(node: ast.Call) -> str:
+    """Terminal name of a call's function: jax.jit -> 'jit'."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); '' if not a plain
+    dotted path."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jit_reference(node: ast.AST) -> bool:
+    """Does this expression denote jax.jit (Name 'jit' or *.jit)?"""
+    d = dotted_name(node)
+    return d == "jit" or d.endswith(".jit")
+
+
+@dataclasses.dataclass
+class JitSig:
+    """Static-argument signature of one jit-wrapped entry point."""
+
+    name: str
+    params: Optional[List[str]]  # positional order, None if unknown
+    static_names: Set[str]
+    static_nums: Set[int]
+    where: str
+
+    def static_param_at(self, idx: int) -> Optional[str]:
+        if idx in self.static_nums:
+            return f"argnum {idx}"
+        if self.params is not None and idx < len(self.params):
+            p = self.params[idx]
+            if p in self.static_names:
+                return p
+        return None
+
+
+def _const_str_seq(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+def _const_int_seq(node: ast.AST) -> Set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)}
+    return set()
+
+
+def jit_call_statics(call: ast.Call) -> Tuple[Set[str], Set[int]]:
+    """static_argnames/static_argnums from a jax.jit(...) or
+    functools.partial(jax.jit, ...) call."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names |= _const_str_seq(kw.value)
+        elif kw.arg == "static_argnums":
+            nums |= _const_int_seq(kw.value)
+    return names, nums
+
+
+def _jit_decorator_statics(dec: ast.AST
+                           ) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_names, static_nums) when ``dec`` is a jit decorator:
+    @jax.jit, @jit, @jax.jit(...), @functools.partial(jax.jit, ...)."""
+    if is_jit_reference(dec):
+        return set(), set()
+    if isinstance(dec, ast.Call):
+        if is_jit_reference(dec.func):
+            return jit_call_statics(dec)
+        if call_name(dec) == "partial" and dec.args \
+                and is_jit_reference(dec.args[0]):
+            return jit_call_statics(dec)
+    return None
+
+
+def _fn_params(node: ast.AST) -> Optional[List[str]]:
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    a = node.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+class Project:
+    """Cross-file context shared by all rules."""
+
+    def __init__(self, modules: Sequence[ModuleSource]):
+        self.modules = list(modules)
+        self.module_names = {m.module_name for m in modules}
+        self.jit_sigs: Dict[str, JitSig] = {}
+        self.reachable_fq: Set[str] = set()
+        self._reachable_nodes: Dict[str, Set[ast.AST]] = {}
+        self._collect_jit_sigs()
+        self._reachability_fixpoint()
+
+    # -- jit signatures ----------------------------------------------------
+
+    def _collect_jit_sigs(self) -> None:
+        for mod in self.modules:
+            for fi in mod.functions:
+                node = fi.node
+                if isinstance(node, ast.Lambda):
+                    continue
+                for dec in node.decorator_list:
+                    statics = _jit_decorator_statics(dec)
+                    if statics is None:
+                        continue
+                    names, nums = statics
+                    sig = JitSig(fi.name, _fn_params(node), names, nums,
+                                 f"{mod.path}:{node.lineno}")
+                    self.jit_sigs[fi.name] = sig
+                    self.jit_sigs[f"{mod.module_name}.{fi.name}"] = sig
+            # g = jax.jit(f, static_argnames=...) at module level
+            for node in mod.tree.body:
+                if not (isinstance(node, ast.Assign) and len(node.targets)
+                        == 1 and isinstance(node.targets[0], ast.Name)):
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call) and is_jit_reference(v.func):
+                    names, nums = jit_call_statics(v)
+                    target = v.args[0] if v.args else None
+                    params = None
+                    if isinstance(target, ast.Name):
+                        for fi in mod.functions:
+                            if fi.name == target.id and fi.parent is None:
+                                params = _fn_params(fi.node)
+                    gname = node.targets[0].id
+                    sig = JitSig(gname, params, names, nums,
+                                 f"{mod.path}:{node.lineno}")
+                    self.jit_sigs[gname] = sig
+                    self.jit_sigs[f"{mod.module_name}.{gname}"] = sig
+
+    # -- trace reachability ------------------------------------------------
+
+    def reachable(self, mod: ModuleSource) -> Set[ast.AST]:
+        """Function nodes in ``mod`` whose bodies execute under trace."""
+        return self._reachable_nodes.get(mod.path, set())
+
+    def in_traced_code(self, mod: ModuleSource, node: ast.AST) -> bool:
+        fi = mod.fn_of.get(node)
+        reach = self.reachable(mod)
+        while fi is not None:
+            if fi.node in reach:
+                return True
+            fi = fi.parent
+        return False
+
+    def _module_reachable(self, mod: ModuleSource) -> Set[ast.AST]:
+        by_name = collections.defaultdict(list)
+        for fi in mod.functions:
+            by_name[fi.name].append(fi)
+
+        roots: Set[ast.AST] = set()
+        traced_names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _jit_decorator_statics(dec) is not None:
+                        roots.add(node)
+            elif isinstance(node, ast.Call) \
+                    and call_name(node) in TRACING_CALLS:
+                args = list(node.args) + [
+                    kw.value for kw in node.keywords]
+                for a in args:
+                    if isinstance(a, ast.Lambda):
+                        roots.add(a)
+                    elif isinstance(a, ast.Name):
+                        traced_names.add(a.id)
+        for name in traced_names:
+            for fi in by_name.get(name, ()):
+                roots.add(fi.node)
+        fq_prefix = mod.module_name + "."
+        for fq in self.reachable_fq:
+            if fq.startswith(fq_prefix):
+                bare = fq[len(fq_prefix):]
+                for fi in by_name.get(bare, ()):
+                    if fi.parent is None:  # only module-level defs have
+                        roots.add(fi.node)  # a cross-module address
+
+        # Closure: nested-in-reachable and called-by-name-from-reachable.
+        reach = set(roots)
+        changed = True
+        while changed:
+            changed = False
+            for fi in mod.functions:
+                if fi.node in reach:
+                    continue
+                if fi.parent is not None and fi.parent.node in reach:
+                    reach.add(fi.node)
+                    changed = True
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    continue
+                if not self.in_module_reach(mod, node, reach):
+                    continue
+                for fi in by_name.get(node.func.id, ()):
+                    if fi.node not in reach:
+                        reach.add(fi.node)
+                        changed = True
+        return reach
+
+    def in_module_reach(self, mod: ModuleSource, node: ast.AST,
+                        reach: Set[ast.AST]) -> bool:
+        fi = mod.fn_of.get(node)
+        while fi is not None:
+            if fi.node in reach:
+                return True
+            fi = fi.parent
+        return False
+
+    def _exported_reachable_calls(self, mod: ModuleSource,
+                                  reach: Set[ast.AST]) -> Set[str]:
+        """fq names of project functions called from reachable bodies
+        (the cross-module edge: kernels.score_fixed inside a jitted
+        score_bucket marks serving.kernels.score_fixed reachable)."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self.in_module_reach(mod, node, reach):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value,
+                                                           ast.Name):
+                target = mod.imports.get(f.value.id)
+                if target in self.module_names:
+                    out.add(f"{target}.{f.attr}")
+            elif isinstance(f, ast.Name):
+                target = mod.imports.get(f.id)
+                if target and target.rsplit(".", 1)[0] \
+                        in self.module_names:
+                    out.add(target)
+        return out
+
+    def _reachability_fixpoint(self) -> None:
+        for _ in range(4):  # cross-module depth is tiny in practice
+            new_fq: Set[str] = set()
+            for mod in self.modules:
+                reach = self._module_reachable(mod)
+                self._reachable_nodes[mod.path] = reach
+                new_fq |= self._exported_reachable_calls(mod, reach)
+            if new_fq <= self.reachable_fq:
+                return
+            self.reachable_fq |= new_fq
+
+
+# -- driving ---------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[Path]) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        if p.is_dir():
+            files += sorted(p.rglob("*.py"))
+        else:
+            files.append(p)
+    seen = set()
+    out = []
+    for f in files:
+        if f not in seen:
+            seen.add(f)
+            out.append(f)
+    return out
+
+
+def load_modules(root: Path, files: Sequence[Path]) -> List[ModuleSource]:
+    mods = []
+    for f in files:
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        mod = parse_module(rel, f.read_text())
+        if mod is not None:
+            mods.append(mod)
+    return mods
+
+
+def analyze_modules(modules: Sequence[ModuleSource], rules=None
+                    ) -> List[Violation]:
+    from photon_ml_tpu.analysis import rules as _rules
+    active = rules if rules is not None else _rules.ALL_RULES
+    project = Project(modules)
+    violations: List[Violation] = []
+    for mod in modules:
+        for rule in active:
+            violations += rule.check(mod, project)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule, v.message))
+    return violations
+
+
+def analyze_sources(sources: Dict[str, str], rules=None) -> List[Violation]:
+    """Analyze in-memory {relpath: source} — the test-facing entry."""
+    mods = [m for m in (parse_module(p, s) for p, s in sorted(
+        sources.items())) if m is not None]
+    return analyze_modules(mods, rules=rules)
+
+
+# -- baseline --------------------------------------------------------------
+
+def load_baseline(path: Path) -> collections.Counter:
+    """Baseline file: one fingerprint per line (repeats = multiplicity),
+    '#' comment lines and blanks ignored."""
+    if not path.exists():
+        return collections.Counter()
+    counts: collections.Counter = collections.Counter()
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            counts[line] += 1
+    return counts
+
+
+def write_baseline(path: Path, violations: Sequence[Violation]) -> None:
+    """Deterministic: sorted fingerprints, one per occurrence."""
+    lines = sorted(v.fingerprint for v in violations)
+    header = ("# jaxlint baseline — accepted pre-existing violations "
+              "(gate = no NEW violations).\n"
+              "# Regenerate with: python dev_scripts/jaxlint.py "
+              "--baseline-update\n")
+    path.write_text(header + "".join(line + "\n" for line in lines))
+
+
+def apply_baseline(violations: Sequence[Violation],
+                   baseline: collections.Counter
+                   ) -> Tuple[List[Violation], collections.Counter]:
+    """Split into (new violations, stale baseline entries). A fingerprint
+    occurring N times is covered up to its baseline multiplicity."""
+    budget = collections.Counter(baseline)
+    new: List[Violation] = []
+    for v in violations:
+        if budget[v.fingerprint] > 0:
+            budget[v.fingerprint] -= 1
+        else:
+            new.append(v)
+    stale = +budget  # entries with remaining (unmatched) multiplicity
+    return new, stale

@@ -507,7 +507,9 @@ def _make_kernel(loss: PointwiseLoss, *, r: int, max_iter: int, tol: float,
             def steps(lo, hi):
                 ks = lax.broadcasted_iota(jnp.int32, (hi - lo, 1), 0
                                           ).astype(st.f.dtype)
-                return init_step * jnp.power(shr, ks + float(lo))
+                # `lo` is a python int (tier boundary): adding it to the
+                # float iota keeps st.f's dtype without a host conversion.
+                return init_step * jnp.power(shr, ks + lo)
 
             ok, t_acc, f_new = price(steps(0, t1))
             if n_trials > t1:

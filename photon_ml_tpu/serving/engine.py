@@ -46,6 +46,7 @@ from photon_ml_tpu.models.random_effect import RandomEffectModel
 from photon_ml_tpu.ops.features import CSRFeatures, padded_csr_arrays
 from photon_ml_tpu.serving import kernels
 from photon_ml_tpu.serving.buckets import BucketLadder
+from photon_ml_tpu.utils.tracing_guard import TracingGuard
 from photon_ml_tpu.utils.vocab import SortedVocab
 
 Array = jax.Array
@@ -56,11 +57,19 @@ class ExecutableCache:
     counter. Keys are (bucket shape, model structure fingerprint, dtype);
     each entry wraps its own ``jax.jit`` and is only ever called at its
     bucket's shapes, so ``compilations`` equals the number of distinct
-    executables XLA built."""
+    executables XLA built.
 
-    def __init__(self):
+    Every built entry registers with a :class:`TracingGuard` (shared
+    infrastructure with the coordinate-descent fused step), so the
+    compile-count invariants are assertable rather than hand-counted:
+    ``assert_max_retraces(max_total=N)`` bounds the executables ever
+    built AND their retraces — an evicted-and-rebuilt bucket stays in
+    the guard's totals under a fresh generation name."""
+
+    def __init__(self, guard: Optional[TracingGuard] = None):
         self._entries: Dict[Tuple, Callable] = {}
         self.compilations = 0
+        self.guard = guard if guard is not None else TracingGuard()
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -73,7 +82,17 @@ class ExecutableCache:
         if fn is None:
             fn = self._entries[key] = build()
             self.compilations += 1
+            self.guard.track(f"bucket:{key!r}", fn)
         return fn
+
+    def total_traces(self) -> int:
+        """Traces across every executable ever built (evicted included);
+        equals ``compilations`` exactly when each bucket traced once."""
+        return self.guard.total_traces()
+
+    def assert_max_retraces(self, max_total: Optional[int] = None,
+                            per_fn: Optional[int] = None) -> None:
+        self.guard.assert_max_retraces(max_total=max_total, per_fn=per_fn)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,7 +125,8 @@ class StreamingGameScorer:
 
     def __init__(self, model: GameModel, dtype=jnp.float32,
                  ladder: Optional[BucketLadder] = None,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 tracing_guard: Optional[TracingGuard] = None):
         self.dtype = np.dtype(jnp.dtype(dtype))
         self.ladder = ladder if ladder is not None else BucketLadder()
         self.pipeline_depth = max(1, pipeline_depth)
@@ -115,7 +135,9 @@ class StreamingGameScorer:
         self._shards: Dict[str, int] = {}  # shard id -> n_features
         self._stats = {"dispatches": 0, "requests": 0, "rows_scored": 0,
                        "rows_padded": 0, "nnz_scored": 0, "nnz_padded": 0}
-        self.cache = ExecutableCache()
+        # ``tracing_guard`` lets callers (the pytest fixture, a serving
+        # health check) own the retrace assertions; default = private.
+        self.cache = ExecutableCache(guard=tracing_guard)
 
         dt = jnp.dtype(dtype)
         for name, m in model.models.items():
@@ -421,6 +443,7 @@ class StreamingGameScorer:
     def cache_info(self) -> dict:
         return {"entries": len(self.cache),
                 "compilations": self.cache.compilations,
+                "traces": self.cache.total_traces(),
                 "bucket_shapes": sorted(k[0] for k in self.cache.keys())}
 
     def stats(self) -> dict:

@@ -10,6 +10,12 @@ from photon_ml_tpu.utils.events import (
     TrainingFinishEvent,
     TrainingStartEvent,
 )
+from photon_ml_tpu.utils.tracing_guard import (
+    RetraceError,
+    TracingGuard,
+    assert_max_retraces,
+    trace_count,
+)
 
 __all__ = [
     "Timer",
@@ -20,4 +26,8 @@ __all__ = [
     "PhotonOptimizationLogEvent",
     "TrainingStartEvent",
     "TrainingFinishEvent",
+    "RetraceError",
+    "TracingGuard",
+    "assert_max_retraces",
+    "trace_count",
 ]

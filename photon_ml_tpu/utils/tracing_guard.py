@@ -1,0 +1,145 @@
+"""Runtime retrace guard — the dynamic complement to jaxlint's static
+``retrace-hazard`` rule (photon_ml_tpu/analysis, docs/ANALYSIS.md).
+
+jaxlint proves the TREE has no per-call-recompilation patterns; this
+module proves a RUN had none: it reads each jitted callable's compile
+cache size (``jax.jit`` wrappers expose ``_cache_size()``), so "how many
+times did XLA trace this?" becomes an assertable invariant instead of
+ad-hoc counter bookkeeping. The serving engine's ExecutableCache and the
+coordinate-descent fused step both register their executables here, and
+tests assert their compile-count bounds through one shared mechanism
+(the ``tracing_guard`` pytest fixture in tests/conftest.py).
+
+Typical use::
+
+    guard = TracingGuard()
+    guard.track("step", jitted_step)     # or via ExecutableCache(guard=g)
+    ... hot loop ...
+    guard.assert_max_retraces(per_fn=1)  # every executable traced once
+
+Names are cumulative: tracking a REPLACEMENT callable under a new name
+(as ExecutableCache does on every build) keeps evicted executables'
+traces in the total, so an evict-per-call regression cannot hide behind
+fresh cache objects.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "RetraceError",
+    "TracingGuard",
+    "trace_count",
+    "assert_max_retraces",
+]
+
+
+class RetraceError(AssertionError):
+    """A jitted callable traced (compiled) more often than its budget."""
+
+
+def trace_count(fn: Callable, default: Optional[int] = None) -> int:
+    """Number of traces a ``jax.jit``-wrapped callable has performed —
+    its compile-cache size. ``default`` (if given) is returned for
+    callables without cache introspection; otherwise TypeError."""
+    sizer = getattr(fn, "_cache_size", None)
+    if sizer is None:
+        if default is not None:
+            return default
+        raise TypeError(
+            f"{fn!r} exposes no jit cache introspection (_cache_size); "
+            "pass a jax.jit-wrapped callable, or default= for "
+            "best-effort counting")
+    return int(sizer())
+
+
+def assert_max_retraces(fn: Callable, max_traces: int,
+                        name: str = "") -> None:
+    """Assert a single jitted callable has traced at most ``max_traces``
+    times (its total compile count, first trace included)."""
+    n = trace_count(fn)
+    if n > max_traces:
+        label = name or getattr(fn, "__name__", repr(fn))
+        raise RetraceError(
+            f"{label}: traced {n} times, budget {max_traces} — something "
+            "is defeating the jit cache (unstable static args, shifting "
+            "shapes/dtypes, or per-call jit construction)")
+
+
+class TracingGuard:
+    """Registry of jitted callables with assertable trace budgets.
+
+    ``track(name, fn)`` is cumulative and name-unique: re-tracking a name
+    appends a generation suffix rather than forgetting the old callable,
+    so totals count every executable ever built. Per-name budgets given
+    at track time are checked by :meth:`verify` (which the pytest
+    fixture runs at teardown)."""
+
+    def __init__(self):
+        self._fns: Dict[str, Callable] = {}
+        self._budgets: Dict[str, int] = {}
+        self.total_budget: Optional[int] = None
+
+    def track(self, name: str, fn: Callable,
+              max_traces: Optional[int] = None) -> Callable:
+        base, n = name, 2
+        while name in self._fns:
+            name = f"{base}#{n}"
+            n += 1
+        self._fns[name] = fn
+        if max_traces is not None:
+            self._budgets[name] = max_traces
+        return fn
+
+    def __len__(self) -> int:
+        return len(self._fns)
+
+    def counts(self) -> Dict[str, int]:
+        """name -> trace count; callables without jit introspection
+        (e.g. test doubles) count 0."""
+        return {name: trace_count(fn, default=0)
+                for name, fn in self._fns.items()}
+
+    def total_traces(self) -> int:
+        return sum(self.counts().values())
+
+    def set_budget(self, max_total: int) -> None:
+        """Total-trace budget checked by :meth:`verify` (fixture
+        teardown) — the declarative form of assert_max_retraces."""
+        self.total_budget = max_total
+
+    def assert_max_retraces(self, max_total: Optional[int] = None,
+                            per_fn: Optional[int] = None) -> None:
+        """``max_total``: bound on the SUM of trace counts (== "at most N
+        executables were ever compiled" when entries are single-shape).
+        ``per_fn``: bound every tracked callable individually (1 = each
+        executable traced exactly at its first call, never again)."""
+        counts = self.counts()
+        if max_total is not None and sum(counts.values()) > max_total:
+            worst = sorted(counts.items(), key=lambda kv: -kv[1])[:8]
+            raise RetraceError(
+                f"total traces {sum(counts.values())} exceed budget "
+                f"{max_total} across {len(counts)} tracked callables "
+                f"(worst: {worst}) — a bucket/cache key is not pinning "
+                "what it should, or entries are evicted and rebuilt")
+        if per_fn is not None:
+            over = {k: v for k, v in counts.items() if v > per_fn}
+            if over:
+                raise RetraceError(
+                    f"callables over the per-fn trace budget {per_fn}: "
+                    f"{over} — their arguments' shapes/dtypes/statics "
+                    "are not stable call-to-call")
+
+    def verify(self) -> None:
+        """Check every budget declared via track(..., max_traces=...) and
+        set_budget(). No-op when no budgets were declared."""
+        counts = self.counts()
+        over = {k: (counts.get(k, 0), b)
+                for k, b in self._budgets.items() if counts.get(k, 0) > b}
+        if over:
+            raise RetraceError(
+                "tracked callables exceeded their declared trace "
+                f"budgets: {over}")
+        if self.total_budget is not None:
+            self.assert_max_retraces(max_total=self.total_budget)

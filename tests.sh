@@ -7,7 +7,9 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 echo "== lint =="
-python dev_scripts/lint.py
+# One phase, one file walk: style checks (dev_scripts/lint.py) + the
+# JAX-aware static analysis gate (dev_scripts/jaxlint.py, docs/ANALYSIS.md).
+python dev_scripts/jaxlint.py --with-style
 
 echo "== tests =="
 python -m pytest tests/ -q "$@"

@@ -81,3 +81,16 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(20260729)
+
+
+@pytest.fixture
+def tracing_guard():
+    """Shared retrace-guard fixture (utils/tracing_guard.py): yields a
+    fresh TracingGuard; budgets a test declares (track(..., max_traces=)
+    or set_budget(total)) are verified at teardown, so a compile-count
+    regression fails the test even without an explicit assert."""
+    from photon_ml_tpu.utils.tracing_guard import TracingGuard
+
+    guard = TracingGuard()
+    yield guard
+    guard.verify()

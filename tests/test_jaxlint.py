@@ -1,0 +1,492 @@
+"""jaxlint (photon_ml_tpu/analysis + dev_scripts/jaxlint.py): per-rule
+true-positive AND false-positive fixtures, suppression + baseline
+semantics, gate behavior on injected regressions, and a tree-clean run
+over the actual repository.
+
+Fixture sources carry device-path-looking relative paths
+(photon_ml_tpu/ops/..., photon_ml_tpu/serving/...) because the host-sync
+and dtype-drift rules scope themselves to device-path modules.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from dev_scripts import jaxlint as cli
+from photon_ml_tpu.analysis import (
+    RULE_IDS,
+    analyze_sources,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+JIT_DEF = '''
+import functools
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("fun", "max_iter"))
+def solve(fun, x, max_iter=10):
+    return fun(x)
+'''
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# -- retrace-hazard --------------------------------------------------------
+
+def test_retrace_hazard_flags_lambda_in_static_kwarg():
+    vs = analyze_sources({"photon_ml_tpu/optimization/s.py": JIT_DEF + '''
+
+def caller(x):
+    return solve(fun=lambda y: y + 1, x=x)
+'''})
+    assert rules_of(vs) == ["retrace-hazard"]
+    assert "static arg 'fun'" in vs[0].message
+
+
+def test_retrace_hazard_flags_local_def_in_static_position():
+    vs = analyze_sources({"photon_ml_tpu/optimization/s.py": JIT_DEF + '''
+
+def caller(x):
+    def obj(y):
+        return y * 2
+    return solve(obj, x)
+'''})
+    assert rules_of(vs) == ["retrace-hazard"]
+    assert "locally-defined function 'obj'" in vs[0].message
+
+
+def test_retrace_hazard_flags_cross_module_call_site():
+    vs = analyze_sources({
+        "photon_ml_tpu/optimization/s.py": JIT_DEF,
+        "photon_ml_tpu/algorithm/c.py": '''
+from photon_ml_tpu.optimization.s import solve
+
+
+def caller(x):
+    return solve(fun=lambda y: y, x=x)
+''',
+    })
+    assert rules_of(vs) == ["retrace-hazard"]
+    assert vs[0].path == "photon_ml_tpu/algorithm/c.py"
+
+
+def test_retrace_hazard_flags_per_call_jit():
+    vs = analyze_sources({"photon_ml_tpu/ops/m.py": '''
+import jax
+
+
+def apply(f, x):
+    return jax.jit(f)(x)
+
+
+def loopy(f, xs):
+    g = jax.jit(f)
+    return [g(x) for x in xs]
+'''})
+    assert rules_of(vs) == ["retrace-hazard", "retrace-hazard"]
+
+
+def test_retrace_hazard_accepts_stable_callables_and_cached_builders():
+    """False positives the rule must NOT fire on: module-level functions
+    and bound methods in static positions; jit results that are
+    returned, stored in a cache, or built at module scope."""
+    vs = analyze_sources({"photon_ml_tpu/optimization/s.py": JIT_DEF + '''
+
+def objective(y):
+    return y
+
+
+def caller(x, model):
+    solve(objective, x)
+    return solve(fun=model.value, x=x)
+
+
+def build(f):
+    return jax.jit(f)  # builder: the CALLER owns caching
+
+
+class Cache:
+    def get(self, f, key):
+        fn = jax.jit(f)
+        self._entries[key] = fn
+        return fn
+
+
+TOP_LEVEL = jax.jit(lambda x: x)  # module scope: constructed once
+'''})
+    assert vs == []
+
+
+# -- host-sync -------------------------------------------------------------
+
+def test_host_sync_flags_syncs_inside_jitted_code():
+    vs = analyze_sources({"photon_ml_tpu/ops/m.py": '''
+import jax
+import numpy as np
+
+
+@jax.jit
+def f(x, lo):
+    a = x.sum().item()
+    b = float(lo)
+    c = np.asarray(x)
+    x.block_until_ready()
+    return a + b + c
+'''})
+    assert rules_of(vs) == ["host-sync"] * 4
+
+
+def test_host_sync_sees_through_nested_and_traced_helpers():
+    """Reachability: a lambda handed to lax.while_loop and a helper
+    called from a jitted body are traced code too."""
+    vs = analyze_sources({"photon_ml_tpu/ops/m.py": '''
+import jax
+from jax import lax
+
+
+def helper(x, v):
+    return x * float(v)
+
+
+@jax.jit
+def f(x, v, n):
+    y = helper(x, v)
+    return lax.while_loop(lambda c: c[1] < n,
+                          lambda c: (c[0] + float(v), c[1] + 1), (y, 0))
+'''})
+    assert sorted(rules_of(vs)) == ["host-sync", "host-sync"]
+
+
+def test_host_sync_ignores_host_code_statics_and_enums():
+    """False positives: host-side functions may sync freely; float() of a
+    declared static argname is trace-safe; int(Enum.X) is a python
+    constant; non-device-path modules are out of scope."""
+    vs = analyze_sources({
+        "photon_ml_tpu/ops/m.py": '''
+import functools
+import jax
+
+
+class Reason:
+    OK = 1
+
+
+def host_materialize(x):
+    return float(x) + x.sum().item()
+
+
+@functools.partial(jax.jit, static_argnames=("tol",))
+def f(x, tol):
+    t = float(tol)
+    r = int(Reason.OK)
+    return x * t + r
+''',
+        "photon_ml_tpu/io/m.py": '''
+import jax
+
+
+@jax.jit
+def f(x, lo):
+    return float(lo)
+''',
+    })
+    assert vs == []
+
+
+# -- dtype-drift -----------------------------------------------------------
+
+def test_dtype_drift_flags_f64_and_dtypeless_float_literals():
+    vs = analyze_sources({"photon_ml_tpu/serving/m.py": '''
+import jax.numpy as jnp
+import numpy as np
+
+
+def g(n):
+    a = jnp.zeros(n)
+    b = jnp.array([1.0, 2.0])
+    c = np.zeros(3, np.float64)
+    return a, b, c
+'''})
+    assert rules_of(vs) == ["dtype-drift"] * 3
+
+
+def test_dtype_drift_accepts_explicit_and_inherited_dtypes():
+    vs = analyze_sources({"photon_ml_tpu/serving/m.py": '''
+import jax.numpy as jnp
+
+
+def g(n, x, dt):
+    a = jnp.zeros(n, dt)
+    b = jnp.zeros((), bool)
+    c = jnp.array([1, 2])
+    d = jnp.zeros_like(x)
+    e = jnp.asarray(x)
+    f = jnp.full((3,), 0.5, dt)
+    g2 = jnp.ones(n, dtype=x.dtype)
+    return a, b, c, d, e, f, g2
+'''})
+    assert vs == []
+
+
+def test_dtype_drift_scoped_to_device_paths():
+    vs = analyze_sources({"photon_ml_tpu/diagnostics/m.py": '''
+import jax.numpy as jnp
+
+
+def g(n):
+    return jnp.zeros(n)
+'''})
+    assert vs == []
+
+
+# -- nondeterministic-pytree -----------------------------------------------
+
+def test_nondet_pytree_flags_set_iteration():
+    vs = analyze_sources({"photon_ml_tpu/data/m.py": '''
+def g(xs, t):
+    leaves = [t[k] for k in {"a", "b"}]
+    order = list(set(xs))
+    for k in set(xs):
+        leaves.append(k)
+    return leaves, order
+'''})
+    assert rules_of(vs) == ["nondeterministic-pytree"] * 3
+
+
+def test_nondet_pytree_accepts_sorted_sets_and_dicts():
+    """sorted(set(...)) normalizes order; dicts preserve insertion
+    order in python 3.7+ — neither may fire."""
+    vs = analyze_sources({"photon_ml_tpu/data/m.py": '''
+def g(xs, d):
+    order = sorted(set(xs))
+    keys = list(d)
+    for k in d:
+        order.append(k)
+    for k in sorted({x + 1 for x in xs}):
+        order.append(k)
+    return order, keys
+'''})
+    assert vs == []
+
+
+# -- suppression + fingerprints --------------------------------------------
+
+def test_inline_suppression_silences_one_rule_on_one_line():
+    src = '''
+import jax
+
+
+def apply(f, x):
+    y = jax.jit(f)(x)  # jaxlint: disable=retrace-hazard
+    return jax.jit(f)(y)
+'''
+    vs = analyze_sources({"photon_ml_tpu/ops/m.py": src})
+    assert len(vs) == 1 and vs[0].line == 7  # only the unsuppressed line
+
+
+def test_fingerprints_are_line_number_free():
+    """Shifting a violation down the file must not change its
+    fingerprint — baselines survive unrelated edits."""
+    a = analyze_sources({"photon_ml_tpu/ops/m.py": '''
+import jax
+
+
+def apply(f, x):
+    return jax.jit(f)(x)
+'''})
+    b = analyze_sources({"photon_ml_tpu/ops/m.py": '''
+import jax
+
+PAD = 1
+
+
+def apply(f, x):
+    return jax.jit(f)(x)
+'''})
+    assert a[0].line != b[0].line
+    assert a[0].fingerprint == b[0].fingerprint
+
+
+# -- baseline semantics ----------------------------------------------------
+
+BAD_OPS = '''
+import jax
+
+
+def apply(f, x):
+    return jax.jit(f)(x)
+'''
+
+
+def test_baseline_covers_and_uncovers(tmp_path):
+    vs = analyze_sources({"photon_ml_tpu/ops/m.py": BAD_OPS})
+    assert len(vs) == 1
+    bl = tmp_path / "baseline.txt"
+    write_baseline(bl, vs)
+    new, stale = apply_baseline(vs, load_baseline(bl))
+    assert new == [] and not stale
+    # deleting the entry un-covers the violation
+    new, _ = apply_baseline(vs, load_baseline(tmp_path / "missing.txt"))
+    assert len(new) == 1
+    # a baselined fingerprint occurring MORE often than accepted fails
+    new, _ = apply_baseline(vs + vs, load_baseline(bl))
+    assert len(new) == 1
+
+
+def test_baseline_write_is_deterministic(tmp_path):
+    vs = analyze_sources({
+        "photon_ml_tpu/ops/b.py": BAD_OPS,
+        "photon_ml_tpu/ops/a.py": BAD_OPS,
+    })
+    p1, p2 = tmp_path / "b1.txt", tmp_path / "b2.txt"
+    write_baseline(p1, vs)
+    write_baseline(p2, list(reversed(vs)))
+    assert p1.read_text() == p2.read_text()
+    body = [line for line in p1.read_text().splitlines()
+            if line and not line.startswith("#")]
+    assert body == sorted(body)
+    assert all(line.startswith("photon_ml_tpu/ops/") for line in body)
+
+
+# -- CLI gate --------------------------------------------------------------
+
+CLEAN_MOD = '''
+import jax
+
+
+@jax.jit
+def f(x):
+    return x * 2
+'''
+
+
+def _write_tree(root: Path, files):
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+
+
+def _gate(tmp_path, *extra):
+    # --root scopes the default paths to the tmp tree (photon_ml_tpu/
+    # exists there; absent defaults like bench.py are skipped).
+    return cli.run(["--root", str(tmp_path),
+                    "--baseline", str(tmp_path / "baseline.txt"), *extra])
+
+
+def test_cli_clean_tree_exits_zero(tmp_path, capsys):
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/m.py": CLEAN_MOD})
+    assert _gate(tmp_path) == 0
+    assert "0 new" in capsys.readouterr().out
+
+
+def test_cli_injected_per_call_jit_fails_gate(tmp_path):
+    """Acceptance: injecting a per-call jax.jit into a fixture makes the
+    gate fail."""
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/m.py": CLEAN_MOD})
+    assert _gate(tmp_path) == 0
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/m.py": CLEAN_MOD + '''
+
+def hot_path(g, x):
+    return jax.jit(g)(x)
+'''})
+    assert _gate(tmp_path) == 1
+
+
+def test_cli_baseline_update_then_delete_entry_fails_gate(tmp_path,
+                                                          capsys):
+    """Acceptance: --baseline-update regenerates deterministically and
+    makes the gate pass; deleting any one baseline entry fails it."""
+    _write_tree(tmp_path, {
+        "photon_ml_tpu/ops/bad1.py": BAD_OPS,
+        "photon_ml_tpu/serving/bad2.py": BAD_OPS,
+    })
+    assert _gate(tmp_path) == 1
+    assert _gate(tmp_path, "--baseline-update") == 0
+    first = (tmp_path / "baseline.txt").read_text()
+    assert _gate(tmp_path, "--baseline-update") == 0
+    assert (tmp_path / "baseline.txt").read_text() == first  # deterministic
+    assert _gate(tmp_path) == 0
+    lines = first.splitlines(keepends=True)
+    entries = [i for i, line in enumerate(lines)
+               if line.strip() and not line.startswith("#")]
+    assert len(entries) == 2
+    for drop in entries:  # deleting ANY one entry fails the gate
+        (tmp_path / "baseline.txt").write_text(
+            "".join(line for i, line in enumerate(lines) if i != drop))
+        capsys.readouterr()
+        assert _gate(tmp_path) == 1
+        assert "1 new" in capsys.readouterr().out
+    (tmp_path / "baseline.txt").write_text(first)
+    assert _gate(tmp_path) == 0
+
+
+def test_cli_stale_baseline_entry_noted_not_fatal(tmp_path, capsys):
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/bad1.py": BAD_OPS})
+    assert _gate(tmp_path, "--baseline-update") == 0
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/bad1.py": CLEAN_MOD})  # fixed
+    capsys.readouterr()
+    assert _gate(tmp_path) == 0
+    assert "stale" in capsys.readouterr().out
+
+
+def test_cli_with_style_shares_the_walk(tmp_path, capsys):
+    """--with-style folds dev_scripts/lint.py checks into the same run:
+    a style problem fails the gate even when jaxlint itself is clean."""
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/m.py":
+                           CLEAN_MOD + "x = 1  \n"})  # trailing whitespace
+    capsys.readouterr()
+    assert _gate(tmp_path, "--with-style") == 1
+    out = capsys.readouterr().out
+    assert "trailing whitespace" in out and "0 new" in out
+
+
+def test_cli_baseline_update_refuses_path_subsets(tmp_path, capsys):
+    """Scoped --baseline-update would silently drop accepted entries
+    outside the subset — it must refuse."""
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/bad1.py": BAD_OPS})
+    assert _gate(tmp_path, "--baseline-update",
+                 str(tmp_path / "photon_ml_tpu")) == 2
+    assert "must not be scoped" in capsys.readouterr().out
+    assert not (tmp_path / "baseline.txt").exists()
+
+
+def test_cli_errors_on_nonexistent_explicit_path(tmp_path):
+    """A typo'd explicit path must error, not vacuously pass on 0
+    files."""
+    _write_tree(tmp_path, {"photon_ml_tpu/ops/m.py": CLEAN_MOD})
+    with pytest.raises(SystemExit, match="path not found"):
+        _gate(tmp_path, str(tmp_path / "photon_ml_typo"))
+
+
+def test_cli_list_rules(capsys):
+    assert cli.run(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in RULE_IDS:
+        assert rule_id in out
+
+
+def test_every_rule_has_an_id_and_doc():
+    from photon_ml_tpu.analysis import ALL_RULES
+
+    assert sorted(RULE_IDS) == sorted({
+        "retrace-hazard", "host-sync", "dtype-drift",
+        "nondeterministic-pytree"})
+    for rule in ALL_RULES:
+        assert rule.doc and rule.id
+
+
+# -- the actual tree is clean ----------------------------------------------
+
+def test_repo_tree_is_jaxlint_clean(capsys):
+    """Acceptance: `python dev_scripts/jaxlint.py` exits 0 on the tree
+    (no NEW violations against the checked-in baseline)."""
+    assert cli.run([]) == 0
+    assert "0 new" in capsys.readouterr().out

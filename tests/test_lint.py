@@ -1,0 +1,91 @@
+"""dev_scripts/lint.py (the style half of the lint gate): one
+true-positive and one false-positive case per check, plus a tree-clean
+run over the repository — previously this gate guarded every PR while
+being itself untested."""
+
+from pathlib import Path
+
+from dev_scripts import lint
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def problems(tmp_path, src, name="m.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return [msg for _, _, msg in lint.lint_file(p)]
+
+
+def test_syntax_error_reported_and_short_circuits(tmp_path):
+    msgs = problems(tmp_path, "def f(:\n    pass\n")
+    assert len(msgs) == 1 and "syntax error" in msgs[0]
+
+
+def test_valid_file_is_clean(tmp_path):
+    assert problems(tmp_path, "def f(x):\n    return x\n") == []
+
+
+def test_tab_flagged_spaces_ok(tmp_path):
+    assert "tab character" in problems(tmp_path, "def f():\n\treturn 1\n")
+    assert problems(tmp_path, "def f():\n    return 1\n") == []
+
+
+def test_trailing_whitespace_flagged_clean_line_ok(tmp_path):
+    assert "trailing whitespace" in problems(tmp_path, "x = 1 \n")
+    assert problems(tmp_path, "x = 1\n") == []
+
+
+def test_line_length_boundary(tmp_path):
+    long = "x = " + "1" * 96  # 100 columns: over the 99 limit
+    assert any("line length 100" in m for m in problems(tmp_path, long))
+    assert problems(tmp_path, long[:-1]) == []  # exactly 99 is fine
+
+
+def test_bare_except_flagged_typed_ok(tmp_path):
+    bad = "try:\n    pass\nexcept:\n    pass\n"
+    good = "try:\n    pass\nexcept ValueError:\n    pass\n"
+    assert "bare except" in problems(tmp_path, bad)
+    assert problems(tmp_path, good) == []
+
+
+def test_mutable_default_flagged_immutable_ok(tmp_path):
+    assert "mutable default argument" in problems(
+        tmp_path, "def f(a=[]):\n    return a\n")
+    assert "mutable default argument" in problems(
+        tmp_path, "def f(*, a={}):\n    return a\n")
+    assert problems(tmp_path, "def f(a=(), b=None):\n    return a, b\n") \
+        == []
+
+
+def test_star_import_flagged_plain_ok(tmp_path):
+    assert "star import" in problems(tmp_path, "from os import *\n")
+    assert problems(tmp_path, "import os\n\nprint(os.sep)\n") == []
+
+
+def test_unused_import_flagged_with_exemptions(tmp_path):
+    assert "unused import 'os'" in problems(tmp_path, "import os\n")
+    # used name, alias use, underscore-prefixed, and string-annotation
+    # (forward-ref) uses are all fine
+    assert problems(tmp_path, "import os as _os\n") == []
+    assert problems(
+        tmp_path,
+        "import numpy as np\n\n\ndef f(x: 'np.ndarray'):\n"
+        "    return x\n") == []
+    # __init__.py re-exports: unused imports exempt there
+    assert problems(tmp_path, "import os\n", name="__init__.py") == []
+
+
+def test_tree_clean_run(monkeypatch, capsys):
+    """The gate's own invariant: the repository lints clean via main()
+    over its default paths."""
+    monkeypatch.chdir(REPO)
+    assert lint.main([]) == 0
+    assert "0 problem(s)" in capsys.readouterr().out
+
+
+def test_main_reports_problems_and_exits_nonzero(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\t\n")
+    assert lint.main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "tab character" in out and "unused import" in out

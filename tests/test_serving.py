@@ -216,13 +216,17 @@ def test_executable_cache_counts_builds():
     assert built == ["a", "b", "c"]
 
 
-def test_compile_count_bounded_by_bucket_ladder(rng):
+def test_compile_count_bounded_by_bucket_ladder(rng, tracing_guard):
     """50 random-size requests compile at most (distinct buckets + 1)
-    executables, and re-scoring the same sizes compiles nothing new."""
+    executables, and re-scoring the same sizes compiles nothing new —
+    asserted through the shared tracing_guard infrastructure (every
+    executable the cache ever builds registers there; trace totals count
+    actual XLA traces, not hand-rolled build increments)."""
     train = _dataset(rng, n=80)
     gm = _game_model(rng, train)
     ladder = BucketLadder(min_rows=8, max_rows=64)
-    eng = StreamingGameScorer(gm, dtype=DT, ladder=ladder)
+    eng = StreamingGameScorer(gm, dtype=DT, ladder=ladder,
+                              tracing_guard=tracing_guard)
     sizes = np.random.default_rng(0).integers(1, 65, 50)
     reqs = [_dataset(np.random.default_rng(100 + i), n=int(n))
             for i, n in enumerate(sizes)]
@@ -232,12 +236,50 @@ def test_compile_count_bounded_by_bucket_ladder(rng):
     for r in reqs:
         nnz = tuple(int(r.feature_shards[s].nnz) for s in ("global", "user"))
         expected_keys.add(ladder.bucket_shape(r.num_rows, nnz))
-    assert eng.cache.compilations <= len(expected_keys) + 1
+    # Guard-asserted invariants: executables ever built (and their total
+    # traces) bounded by the ladder, each bucket traced exactly once.
+    eng.cache.assert_max_retraces(max_total=len(expected_keys) + 1,
+                                  per_fn=1)
+    assert eng.cache.total_traces() == eng.cache.compilations
+    assert eng.stats()["traces"] == eng.stats()["compilations"]
     assert eng.stats()["entries"] == eng.cache.compilations
-    before = eng.cache.compilations
+    before = eng.cache.total_traces()
     for r in reqs[:10]:
         eng.score(r)
-    assert eng.cache.compilations == before
+    assert eng.cache.total_traces() == before
+    # Teardown re-checks the bound declaratively via the fixture.
+    tracing_guard.set_budget(len(expected_keys) + 1)
+
+
+def test_tracing_guard_trips_on_per_call_bucket_eviction(rng,
+                                                         tracing_guard):
+    """Injected regression: evict the bucket entry before every dispatch
+    (the exact failure the ExecutableCache exists to prevent). Each
+    dispatch then rebuilds + retraces a fresh executable; the guard keeps
+    evicted generations in its totals, so assert_max_retraces MUST trip
+    even though the cache itself only ever holds one entry."""
+    from photon_ml_tpu.utils.tracing_guard import RetraceError
+
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    eng = StreamingGameScorer(gm, dtype=DT,
+                              ladder=BucketLadder(min_rows=8, max_rows=64),
+                              tracing_guard=tracing_guard)
+    orig = eng.cache.get_or_build
+
+    def evict_then_build(key, build):
+        eng.cache._entries.clear()  # bucket evicted per call
+        return orig(key, build)
+
+    eng.cache.get_or_build = evict_then_build
+    req = _dataset(np.random.default_rng(11), n=16)
+    for _ in range(6):  # same bucket shape every time: SHOULD be 1 compile
+        eng.score(req)
+    assert len(tracing_guard) == 6  # every evicted generation tracked
+    with pytest.raises(RetraceError, match="exceed budget"):
+        eng.cache.assert_max_retraces(max_total=2)
+    with pytest.raises(RetraceError):
+        tracing_guard.assert_max_retraces(max_total=2)
 
 
 def test_bucket_ladder_shapes():
