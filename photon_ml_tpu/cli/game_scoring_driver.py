@@ -25,15 +25,11 @@ import argparse
 import json
 import sys
 import time
-from collections import deque
 from pathlib import Path
 
 import numpy as np
 
-from photon_ml_tpu.data.avro_reader import (
-    iter_game_dataset_batches,
-    read_game_dataset,
-)
+from photon_ml_tpu.data.avro_reader import read_game_dataset
 from photon_ml_tpu.evaluation import build_evaluator
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import write_container
@@ -60,11 +56,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id-types", default=None)
     p.add_argument("--stream", action="store_true",
                    help="score through the streaming serving engine in "
-                        "bounded memory (one --batch-rows batch of rows "
-                        "resident at a time; note --evaluators still "
+                        "bounded memory (O(batch-rows x prefetch depth) "
+                        "rows resident; note --evaluators still "
                         "accumulates per-row evaluation columns)")
     p.add_argument("--batch-rows", type=int, default=4096,
                    help="rows per streamed scoring batch (--stream only)")
+    p.add_argument("--feeder", choices=["auto", "native", "python"],
+                   default="auto",
+                   help="--stream decode path: the native C block "
+                        "decoder ('auto' falls back to the byte-"
+                        "identical python record loop when the "
+                        "extension is unbuilt or the schema doesn't "
+                        "fit; 'native' errors instead; 'python' forces "
+                        "the record loop)")
+    p.add_argument("--prefetch-batches", type=int, default=2,
+                   help="batches the --stream feeder decodes ahead on a "
+                        "background thread (0 = synchronous decode; "
+                        "peak resident batches stay bounded by this "
+                        "depth + 2)")
     return p
 
 
@@ -179,11 +188,14 @@ def run(argv=None) -> dict:
 
 def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
                 scores_path, logger) -> dict:
-    """Bounded-memory scoring: Avro batches -> serving engine pipeline ->
-    incremental ScoringResultAvro writes. Only evaluation columns (when
-    evaluators are requested) accumulate across batches — never features —
-    so metrics cost O(total rows) of scalars/id strings while feature
-    memory stays O(batch_rows)."""
+    """Bounded-memory scoring through the three-stage decode -> H2D ->
+    dispatch pipeline (serving engine `score_container_stream`: the
+    block-stream feeder decodes + featureizes batch k+1 on its prefetch
+    thread while batch k's dispatch is in flight), with incremental
+    ScoringResultAvro writes. Only evaluation columns (when evaluators are
+    requested) accumulate across batches — never features — so metrics
+    cost O(total rows) of scalars/id strings while feature memory stays
+    O(batch_rows x (prefetch + pipeline depth))."""
     from photon_ml_tpu.data.game_data import GameDataset
     from photon_ml_tpu.serving import StreamingGameScorer
 
@@ -193,22 +205,21 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
         raise SystemExit(
             f"--stream requires a device-scorable model: {e}") from e
 
-    batches = iter_game_dataset_batches(
-        inputs, id_types=id_types, feature_shard_maps=shard_maps,
-        batch_rows=args.batch_rows)
-    held: deque = deque()  # datasets whose dispatch is in flight
+    try:
+        scored = engine.score_container_stream(
+            inputs, id_types=id_types, feature_shard_maps=shard_maps,
+            batch_rows=args.batch_rows, feeder=args.feeder,
+            prefetch_depth=args.prefetch_batches)
+    except RuntimeError as e:
+        raise SystemExit(str(e)) from e
+    logger.info("streamed scoring: %s feeder, prefetch depth %d",
+                scored.stream.decode_path, scored.stream.prefetch_depth)
     counters = {"rows": 0, "batches": 0}
     acc = {"scores": [], "responses": [], "offsets": [], "weights": [],
            "ids": {t: [] for t in id_types}} if evaluators else None
 
-    def feed():
-        for ds in batches:
-            held.append(ds)
-            yield ds
-
     def scored_records():
-        for scores in engine.score_stream(feed()):
-            ds = held.popleft()
+        for ds, scores in scored:
             counters["rows"] += ds.num_rows
             counters["batches"] += 1
             if acc is not None:
@@ -246,6 +257,7 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
         "scoringPath": "streaming-engine",
         "numBatches": counters["batches"],
         "batchRows": args.batch_rows,
+        "feeder": scored.stream.stats(),
         "engine": engine.stats(),
     }
 

@@ -218,6 +218,18 @@ class _GameBatchBuilder:
         self._weights: list = []
         self._uids: list = []
         self._ids: Dict[str, list] = {t: [] for t in id_types}
+        # Per-record work hoisted out of append(): one
+        # (key->index dict .get, intercept index, column lists) tuple per
+        # shard, so the hot loop is dict lookups + list appends on locals
+        # — no method dispatch, no dict-of-dicts traversal per record.
+        self._shard_ops = []
+        for s, imap in feature_shard_maps.items():
+            b = self._builders[s]
+            self._shard_ops.append(
+                (imap.key_to_index_dict().get,
+                 imap.intercept_index if add_intercept else -1,
+                 b["data"], b["indices"], b["indptr"]))
+        self._id_ops = [(t, self._ids[t]) for t in id_types]
 
     def __len__(self) -> int:
         return len(self._labels)
@@ -229,25 +241,26 @@ class _GameBatchBuilder:
         self._weights.append(1.0 if w is None else float(w))
         self._uids.append(rec.get("uid"))
         metadata = rec.get("metadataMap") or {}
-        for t in self._id_types:
+        for t, lst in self._id_ops:
             v = metadata.get(t)
             if v is None:
                 raise ValueError(
                     f"record is missing id type {t!r} in metadataMap")
-            self._ids[t].append(str(v))
-        for shard, imap in self._maps.items():
-            b = self._builders[shard]
-            for f in _record_features(rec):
-                idx = imap.get_index(feature_key(f["name"],
-                                                 f.get("term") or ""))
+            lst.append(str(v))
+        # Feature keys are built ONCE per record, not once per shard.
+        feats = [(feature_key(f["name"], f.get("term") or ""), f["value"])
+                 for f in _record_features(rec)]
+        for get_index, intercept_idx, data, indices, indptr in \
+                self._shard_ops:
+            for key, value in feats:
+                idx = get_index(key, -1)
                 if idx >= 0:
-                    b["indices"].append(idx)
-                    b["data"].append(float(f["value"]))
-            ii = imap.intercept_index
-            if self._add_intercept and ii >= 0:
-                b["indices"].append(ii)
-                b["data"].append(1.0)
-            b["indptr"].append(len(b["indices"]))
+                    indices.append(idx)
+                    data.append(float(value))
+            if intercept_idx >= 0:
+                indices.append(intercept_idx)
+                data.append(1.0)
+            indptr.append(len(indices))
 
     def build(self) -> GameDataset:
         n = len(self._labels)
@@ -277,36 +290,34 @@ def iter_game_dataset_batches(
     feature_shard_maps: Dict[str, IndexMap],
     batch_rows: int,
     add_intercept: bool = True,
+    feeder: str = "auto",
+    prefetch_depth: int = 0,
 ) -> Iterator[GameDataset]:
     """Streaming GAME ingest: yield GameDatasets of <= ``batch_rows`` rows.
 
     The bounded-memory feeder for the serving engine's scoring stream
-    (cli/game_scoring_driver --stream): only one batch of rows is ever
-    resident on the host, so arbitrarily large Avro inputs score in
-    O(batch_rows) memory. Record decoding is ``read_game_dataset``'s own
-    row loop (shared ``_GameBatchBuilder`` — same duplicate-feature
-    rejection, same metadataMap id extraction); each batch's entity
+    (cli/game_scoring_driver --stream): only O(batch_rows +
+    prefetch_depth * batch_rows) rows are ever resident on the host, so
+    arbitrarily large Avro inputs score in bounded memory. Decoding runs
+    block-streamed through the native C decoder when available
+    (data/block_stream.py — `shard_planner` block index + per-block
+    `decode_training_block`), with a byte-identical pure-python fallback
+    (the shared ``_GameBatchBuilder`` row loop — same duplicate-feature
+    rejection, same metadataMap id extraction). Each batch's entity
     vocabularies are batch-local — consumers joining against a model
     vocabulary must map through entity NAMES, which is exactly what the
     serving engine does.
 
-    KNOWN LIMIT: this feeder decodes through the pure-python record path
-    — the C block decoder (fast_ingest / parallel_ingest) decodes whole
-    files, not bounded batches, so it cannot back this generator yet.
-    Streaming the native decoder per block run is the ROADMAP follow-up;
-    until then decode (~10k rows/s/core) bounds --stream throughput.
+    ``feeder``: "auto" | "native" | "python"; ``prefetch_depth`` > 0
+    decodes ahead on a background thread (see
+    block_stream.BlockGameStream for the exact residency bound).
     """
-    if batch_rows < 1:
-        raise ValueError(f"batch_rows must be >= 1, got {batch_rows}")
-    batch = _GameBatchBuilder(feature_shard_maps, id_types, add_intercept)
-    for rec in iter_records(path):
-        batch.append(rec)
-        if len(batch) >= batch_rows:
-            yield batch.build()
-            batch = _GameBatchBuilder(feature_shard_maps, id_types,
-                                      add_intercept)
-    if len(batch):
-        yield batch.build()
+    from photon_ml_tpu.data.block_stream import BlockGameStream
+
+    yield from BlockGameStream(
+        path, id_types=id_types, feature_shard_maps=feature_shard_maps,
+        batch_rows=batch_rows, add_intercept=add_intercept,
+        feeder=feeder, prefetch_depth=prefetch_depth)
 
 
 def read_game_dataset(

@@ -1,0 +1,322 @@
+"""Block-streaming GameDataset feeder: bounded-memory, C-decoded, prefetched.
+
+The serving engine dispatches streamed scoring at ~10x the rate the
+pure-python avro record loop can feed it (BENCH_full.json
+`extra.serving.batch_curve` vs the ~13k rows/s record path), so `--stream`
+scoring was feeder-bound. This module closes that gap with the same two
+mechanisms the training ingest already uses, re-pointed at bounded batches
+instead of whole files:
+
+1. **Block-level native decode** — containers are indexed with
+   `shard_planner.scan_container_blocks` (two varints read per block,
+   payloads seeked over) and each block's payload is decoded straight to
+   CSR triplets + label/id columns by the C decoder
+   (`native/_avro_native.c decode_training_block`, the `fast_ingest`
+   path). Decoded rows accumulate in a host-side column buffer and are cut
+   into GameDatasets of EXACTLY ``batch_rows`` rows — block boundaries
+   never leak into batch boundaries, so the output is byte-identical
+   (values, row order, dtypes, entity vocabularies) to the pure-python
+   record loop, which remains as the fallback when the extension is
+   unbuilt or a schema doesn't fit the training layout.
+2. **Prefetch** — a background thread (`device_feed.HostPrefetcher`) runs
+   decode + featureize of batch k+1 while the consumer dispatches batch k;
+   combined with the engine's `InFlightWindow` dispatch pipelining this
+   yields the three-stage decode → H2D → dispatch pipeline
+   (`StreamingGameScorer.score_container_stream`). Peak resident batches
+   stay bounded by ``prefetch_depth + 2`` (queue + producer's hand +
+   consumer's hand) — the bounded-memory contract is asserted in
+   tests/test_block_stream.py.
+
+This is the single-host analog of the reference's per-iteration scoring
+flow over HDFS splits (`GameScoringDriver` / `AvroDataReader.scala`
+executor-parallel decode), cf. the tf.data-style prefetch pipelines in
+PAPERS.md: decode must overlap device execution, not serialize with it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.avro_reader import (
+    _avro_paths,
+    _GameBatchBuilder,
+    _reject_duplicate_features,
+    iter_records,
+)
+from photon_ml_tpu.data.device_feed import HostPrefetcher
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.index_map import DELIMITER, IndexMap
+from photon_ml_tpu.data.shard_planner import (
+    FileBlockIndex,
+    read_block,
+    scan_paths,
+)
+
+FEEDERS = ("auto", "native", "python")
+
+
+def _load_native():
+    from photon_ml_tpu.native import load_avro_native
+
+    native = load_avro_native()
+    if native is None or not hasattr(native, "decode_training_block"):
+        return None
+    return native
+
+
+class _ColumnBuffer:
+    """Decoded-but-unbatched rows, as per-block column chunks.
+
+    `put_block` appends one decoded block's columns; `take(n)` cuts the
+    oldest ``n`` rows into a GameDataset (concatenating chunks only at cut
+    time, so the steady-state cost is one O(batch) concatenate per batch
+    and the remainder re-seeds as a single chunk)."""
+
+    def __init__(self, shard_maps: Dict[str, IndexMap],
+                 id_types: Sequence[str]):
+        self._maps = shard_maps
+        self._id_types = tuple(id_types)
+        self.rows = 0
+        self._labels: List[np.ndarray] = []
+        self._offsets: List[np.ndarray] = []
+        self._weights: List[np.ndarray] = []
+        self._uids: List[Optional[str]] = []
+        # shard -> (vals chunks, cols chunks, row-length chunks)
+        self._shards = {s: ([], [], []) for s in shard_maps}
+        self._ids: Dict[str, list] = {t: [] for t in self._id_types}
+
+    def put_block(self, decoded, count: int, layout) -> None:
+        lb, ob, wb, us, shard_out, ids_out = decoded
+        self._labels.append(np.frombuffer(lb, np.float64))
+        # Mirror fast_ingest exactly: one chunk per block regardless of
+        # optional fields, so mixed-layout files cannot misalign rows.
+        self._offsets.append(np.frombuffer(ob, np.float64)
+                             if layout.has_offset else np.zeros(count))
+        self._weights.append(np.frombuffer(wb, np.float64)
+                             if layout.has_weight else np.ones(count))
+        self._uids.extend(us if layout.has_uid else [None] * count)
+        for s, (vb, cb, rb) in zip(self._shards, shard_out):
+            vals_c, cols_c, rlen_c = self._shards[s]
+            vals_c.append(np.frombuffer(vb, np.float64))
+            cols_c.append(np.frombuffer(cb, np.int64))
+            rlen_c.append(np.frombuffer(rb, np.int64))
+        for t, lst in zip(self._id_types, ids_out):
+            self._ids[t].extend(lst)
+        self.rows += count
+
+    @staticmethod
+    def _cat(chunks: List[np.ndarray], dtype) -> np.ndarray:
+        """Concatenate to ONE writable array (np.frombuffer chunks are
+        read-only, but the CSR canonicalization in
+        `_reject_duplicate_features` sorts indices in place)."""
+        if not chunks:
+            return np.zeros(0, dtype)
+        if len(chunks) == 1:
+            c = chunks[0]
+            return c if c.flags.writeable else c.copy()
+        return np.concatenate(chunks)
+
+    def take(self, n: int) -> GameDataset:
+        """Cut the oldest ``n`` rows (n <= self.rows) into a GameDataset
+        byte-identical to what `_GameBatchBuilder` builds for the same
+        records."""
+        labels = self._cat(self._labels, np.float64)
+        offsets = self._cat(self._offsets, np.float64)
+        weights = self._cat(self._weights, np.float64)
+        self._labels = [labels[n:]] if n < len(labels) else []
+        self._offsets = [offsets[n:]] if n < len(offsets) else []
+        self._weights = [weights[n:]] if n < len(weights) else []
+        uids = self._uids[:n]
+        self._uids = self._uids[n:]
+
+        shards = {}
+        for s, imap in self._maps.items():
+            vals_c, cols_c, rlen_c = self._shards[s]
+            vals = self._cat(vals_c, np.float64)
+            cols = self._cat(cols_c, np.int64)
+            rlens = self._cat(rlen_c, np.int64)
+            nnz = int(rlens[:n].sum())
+            indptr = np.zeros(n + 1, np.int64)
+            np.cumsum(rlens[:n], out=indptr[1:])
+            mat = sp.csr_matrix(
+                (vals[:nnz], cols[:nnz], indptr), shape=(n, len(imap)))
+            _reject_duplicate_features(mat, imap, uids, s)
+            shards[s] = mat
+            self._shards[s] = ([vals[nnz:]] if nnz < len(vals) else [],
+                               [cols[nnz:]] if nnz < len(cols) else [],
+                               [rlens[n:]] if n < len(rlens) else [])
+        ids = {}
+        for t in self._id_types:
+            ids[t] = np.asarray(self._ids[t][:n])
+            self._ids[t] = self._ids[t][n:]
+        self.rows -= n
+        return GameDataset.build(
+            responses=labels[:n],
+            feature_shards=shards,
+            ids=ids,
+            offsets=offsets[:n],
+            weights=weights[:n],
+            uids=np.asarray([u if u is not None else "" for u in uids]),
+        )
+
+
+class BlockGameStream:
+    """Bounded-memory streaming GAME ingest: iterate GameDatasets of
+    <= ``batch_rows`` rows (exactly ``batch_rows`` except the final
+    partial batch) decoded through the native C block decoder when
+    available, with a byte-identical pure-python fallback.
+
+    ``feeder``: "auto" (C when the extension is built AND every file's
+    schema fits the training layout, else python), "native" (require the
+    C path; raises RuntimeError when unavailable), or "python" (force the
+    record loop — parity tests, benchmarks).
+
+    ``prefetch_depth``: > 0 decodes ahead on a background thread, holding
+    at most that many finished batches (peak resident batches <=
+    ``prefetch_depth + 2`` — see device_feed.HostPrefetcher); 0 decodes
+    synchronously in the consumer's loop.
+
+    Telemetry accumulates on the instance across iteration:
+    ``decode_path`` ("native" | "python", resolved eagerly at
+    construction), ``batches``, ``rows``, ``peak_resident_batches``.
+
+    Each batch's entity vocabularies are batch-local — consumers joining
+    against a model vocabulary must map through entity NAMES, which is
+    exactly what the serving engine does.
+    """
+
+    def __init__(self, path, id_types: Sequence[str],
+                 feature_shard_maps: Dict[str, IndexMap],
+                 batch_rows: int, add_intercept: bool = True,
+                 feeder: str = "auto", prefetch_depth: int = 2):
+        if batch_rows < 1:
+            raise ValueError(f"batch_rows must be >= 1, got {batch_rows}")
+        if feeder not in FEEDERS:
+            raise ValueError(f"feeder must be one of {FEEDERS}, "
+                             f"got {feeder!r}")
+        self._path = path
+        self._id_types = tuple(id_types)
+        self._maps = dict(feature_shard_maps)
+        self._batch_rows = int(batch_rows)
+        self._add_intercept = add_intercept
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        self.batches = 0
+        self.rows = 0
+        self.peak_resident_batches = 0
+
+        self._indexes: List[FileBlockIndex] = []
+        self._layouts: list = []
+        self.decode_path = "python"
+        native = None if feeder == "python" else _load_native()
+        why = "native decoder unavailable"
+        if native is not None:
+            self._indexes = scan_paths(_avro_paths(path))
+            why = self._compile_layouts()
+            if why is None:
+                self.decode_path = "native"
+        if feeder == "native" and self.decode_path != "native":
+            raise RuntimeError(
+                f"feeder='native' requested but the C block path does not "
+                f"apply: {why}")
+        self._native = native if self.decode_path == "native" else None
+
+    def _compile_layouts(self) -> Optional[str]:
+        """Layout per file (aligned with self._indexes); returns a reason
+        string when any file's schema can't decode natively, None on
+        success."""
+        from photon_ml_tpu.data.fast_ingest import build_training_layout
+        from photon_ml_tpu.io.avro_codec import Schema
+
+        self._layouts = []
+        for ix in self._indexes:
+            layout = build_training_layout(Schema(ix.schema_json).root)
+            if layout is None:
+                return (f"{ix.path}: schema does not fit the native "
+                        "training layout")
+            if self._id_types and not layout.has_metadata:
+                return f"{ix.path}: id types requested but no metadataMap"
+            self._layouts.append(layout)
+        return None
+
+    # -- iteration ---------------------------------------------------------
+
+    def __iter__(self) -> Iterator[GameDataset]:
+        src = (self._iter_native() if self.decode_path == "native"
+               else self._iter_python())
+        if self.prefetch_depth < 1:
+            for ds in src:
+                self.peak_resident_batches = max(
+                    self.peak_resident_batches, 1)
+                yield ds
+            return
+        prefetcher = HostPrefetcher(src, self.prefetch_depth)
+        try:
+            yield from prefetcher
+        finally:
+            self.peak_resident_batches = max(self.peak_resident_batches,
+                                             prefetcher.peak_resident)
+
+    def _count(self, ds: GameDataset) -> GameDataset:
+        self.batches += 1
+        self.rows += ds.num_rows
+        return ds
+
+    def _iter_python(self) -> Iterator[GameDataset]:
+        """The record-at-a-time loop — ONE copy of the python-path batch
+        semantics via `_GameBatchBuilder` (shared with
+        `read_game_dataset`'s fallback)."""
+        batch = _GameBatchBuilder(self._maps, self._id_types,
+                                  self._add_intercept)
+        for rec in iter_records(self._path):
+            batch.append(rec)
+            if len(batch) >= self._batch_rows:
+                yield self._count(batch.build())
+                batch = _GameBatchBuilder(self._maps, self._id_types,
+                                          self._add_intercept)
+        if len(batch):
+            yield self._count(batch.build())
+
+    def _iter_native(self) -> Iterator[GameDataset]:
+        shard_names = list(self._maps)
+        dicts_t = tuple(self._maps[s].key_to_index_dict()
+                        for s in shard_names)
+        icepts_t = tuple(
+            int(self._maps[s].intercept_index if self._add_intercept
+                else -1)
+            for s in shard_names)
+        buf = _ColumnBuffer(self._maps, self._id_types)
+        for ix, layout in zip(self._indexes, self._layouts):
+            if not ix.blocks:
+                continue
+            with open(ix.path, "rb") as f:
+                f.seek(ix.blocks[0].offset)
+                for b in ix.blocks:
+                    _, payload = read_block(
+                        f, ix.codec, ix.sync, ix.path,
+                        expected=(b.count, b.payload_bytes, b.offset))
+                    try:
+                        decoded = self._native.decode_training_block(
+                            payload, b.count, layout.prog, layout.layout,
+                            dicts_t, icepts_t, self._id_types, DELIMITER,
+                            None)
+                    except ValueError as e:
+                        raise ValueError(
+                            f"{ix.path}: block at offset {b.offset} "
+                            f"failed to decode: {e}") from e
+                    buf.put_block(decoded, b.count, layout)
+                    while buf.rows >= self._batch_rows:
+                        yield self._count(buf.take(self._batch_rows))
+        if buf.rows:
+            yield self._count(buf.take(buf.rows))
+
+    def stats(self) -> dict:
+        return {
+            "decode_path": self.decode_path,
+            "prefetch_depth": self.prefetch_depth,
+            "batches": self.batches,
+            "rows": self.rows,
+            "peak_resident_batches": self.peak_resident_batches,
+        }

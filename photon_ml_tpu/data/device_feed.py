@@ -17,10 +17,20 @@ chunks over time (the multi-process decode pipeline: workers hand the
 parent shard columns while later shards are still decoding —
 data/parallel_ingest.py's ``column_consumer`` hook plugs straight into
 ``submit``).
+
+``HostPrefetcher`` is the host-side dual of ``InFlightWindow``: where the
+window bounds async DEVICE work already dispatched, the prefetcher bounds
+host PRODUCTION of future work — a background thread runs an iterator
+(e.g. block decode + featureize of batch k+1, data/block_stream.py) while
+the consumer's loop body (device dispatch of batch k) executes, holding at
+most ``depth`` finished items. Chaining the two gives the three-stage
+decode → H2D → dispatch pipeline of streamed scoring.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
 from collections import deque
 
 import numpy as np
@@ -143,6 +153,77 @@ class InFlightWindow:
             item, ready = self._q.popleft()
             jax.block_until_ready(ready)
             yield item
+
+
+class HostPrefetcher:
+    """Bounded background-thread prefetch of an iterator.
+
+    ``iter(HostPrefetcher(src, depth))`` yields ``src``'s items in order
+    while a daemon thread keeps producing ahead, blocking once ``depth``
+    finished items wait unconsumed — so the producer can never run the
+    host out of memory. Items RESIDENT at any instant are bounded by
+    ``depth`` (queued) + 1 (in the producer's hand, blocked on a full
+    queue) + 1 (held by the consumer) = ``depth + 2``; ``peak_resident``
+    records the high-water mark of the first two terms plus the
+    consumer's (so its bound is exactly ``depth + 2``).
+
+    Producer exceptions re-raise in the consumer at the position they
+    occurred; abandoning the iterator mid-stream (``close()``/GC of the
+    generator) stops the producer promptly via a poll-stop flag rather
+    than leaving it blocked on a full queue forever.
+    """
+
+    _POLL_S = 0.05
+
+    def __init__(self, src, depth: int = 2):
+        self._src = src
+        self._depth = max(1, depth)
+        self.peak_resident = 0
+
+    def __iter__(self):
+        q: "queue.Queue[tuple]" = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+        lock = threading.Lock()
+        in_flight = [0]  # produced, not yet handed to the consumer
+
+        def put(msg) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(msg, timeout=self._POLL_S)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in self._src:
+                    with lock:
+                        in_flight[0] += 1
+                        # +1: the item the consumer currently holds.
+                        self.peak_resident = max(self.peak_resident,
+                                                 in_flight[0] + 1)
+                    if not put(("item", item)):
+                        return
+                put(("done", None))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                put(("err", e))
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="host-prefetch")
+        t.start()
+        try:
+            while True:
+                kind, val = q.get()
+                if kind == "done":
+                    break
+                if kind == "err":
+                    raise val
+                with lock:
+                    in_flight[0] -= 1
+                yield val
+        finally:
+            stop.set()
 
 
 class OverlappedUploader:

@@ -168,9 +168,7 @@ def _discard_transport(transport) -> None:
 
 def _decode_shard(shard) -> tuple:
     """Decode one shard's blocks; never raises (errors return as values)."""
-    import zlib
-
-    from photon_ml_tpu.io.avro_codec import _read_long
+    from photon_ml_tpu.data.shard_planner import read_block
 
     try:
         native = _W["native"]
@@ -191,17 +189,8 @@ def _decode_shard(shard) -> tuple:
         with open(shard.path, "rb") as f:
             f.seek(shard.offset)
             for _ in range(shard.num_blocks):
-                count = _read_long(f)
-                size = _read_long(f)
-                payload = f.read(size)
-                if len(payload) != size:
-                    raise ValueError(
-                        f"truncated block payload (wanted {size} bytes, "
-                        f"got {len(payload)})")
-                if shard.codec == "deflate":
-                    payload = zlib.decompress(payload, -15)
-                if f.read(16) != shard.sync:
-                    raise ValueError("sync marker mismatch after block")
+                count, payload = read_block(f, shard.codec, shard.sync,
+                                            shard.path)
                 (lb, ob, wb, us, shard_out, ids_out) = \
                     native.decode_training_block(
                         payload, count, prog, layout, dicts_t, icepts_t,

@@ -1,13 +1,19 @@
-"""Shard planner: split Avro container files into block-aligned byte-range
-shards for multi-process ingest.
+"""Shard planner: index Avro container files at the block level and split
+them into block-aligned byte-range shards.
 
 An Avro object container file is a header followed by independent blocks
 (count varint, byte-size varint, payload, 16-byte sync marker). Blocks are
 self-contained — a worker that knows the file's codec, sync marker and a
 block's byte offset can decode it without touching the header — so the
-natural shard unit is a CONSECUTIVE run of blocks. Scanning the block index
-reads only the two varints per block (payloads are seeked over), so
+natural decode unit is a CONSECUTIVE run of blocks. Scanning the block
+index reads only the two varints per block (payloads are seeked over), so
 planning costs O(blocks) seeks, not O(bytes).
+
+Two consumers share the index: `data/parallel_ingest.py` groups block runs
+into byte-balanced shards decoded by a process pool (whole-file ingest),
+and `data/block_stream.py` walks one file's run in order, cutting decoded
+rows into bounded batches (streamed scoring) — same block scan, same
+failure surface, different parallelism shape.
 
 Shards never span files and carry a global sequence number; a consumer that
 assembles results in sequence order reproduces the single-process row order
@@ -136,6 +142,51 @@ def scan_container_blocks(path) -> FileBlockIndex:
             blocks.append(BlockSpan(offset, size, count))
     return FileBlockIndex(path=path, codec=codec, sync=sync,
                           schema_json=schema_json, blocks=blocks)
+
+
+def read_block(f, codec: str, sync: bytes, path: str,
+               expected=None):
+    """Read ONE container block at the current file position: returns
+    (record_count, decompressed_payload), consuming the trailing sync
+    marker and verifying it.
+
+    The single copy of the block-read idiom both decode consumers use
+    (parallel_ingest worker loop, block_stream streaming loop).
+    ``expected``: optional (count, payload_bytes, offset) from a prior
+    scan — a mismatch means the file changed under the reader. All
+    failures raise ValueError naming the file (and offset when known).
+    """
+    import zlib
+
+    from photon_ml_tpu.io.avro_codec import _read_long
+
+    count = _read_long(f)
+    size = _read_long(f)
+    where = ""
+    if expected is not None:
+        e_count, e_size, offset = expected
+        where = f" at offset {offset}"
+        if (count, size) != (e_count, e_size):
+            raise ValueError(
+                f"{path}: block header{where} changed under the reader "
+                f"(scanned {e_count} rows/{e_size} bytes, read "
+                f"{count}/{size})")
+    payload = f.read(size)
+    if len(payload) != size:
+        raise ValueError(
+            f"{path}: truncated block payload{where} (wanted {size} "
+            f"bytes, got {len(payload)})")
+    if f.read(16) != sync:
+        raise ValueError(
+            f"{path}: sync marker mismatch after block{where}")
+    if codec == "deflate":
+        try:
+            payload = zlib.decompress(payload, -15)
+        except zlib.error as e:
+            raise ValueError(
+                f"{path}: corrupt deflate payload in block{where}: "
+                f"{e}") from e
+    return count, payload
 
 
 def plan_shards(indexes: Sequence[FileBlockIndex],

@@ -31,6 +31,7 @@ real row count before they leave the engine.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import jax
@@ -104,6 +105,23 @@ class _SubSpec:
     shard_id: Optional[str]  # feature shard consumed (None for mf)
     effect_types: Tuple[str, ...]  # id columns consumed ((), 1, or 2)
     vocabs: Tuple[SortedVocab, ...]  # model vocab per effect type
+
+
+class _StreamScoring:
+    """Iterator of (dataset, scores) pairs from
+    ``score_container_stream``, carrying the underlying feeder
+    (``.stream``) so callers can read decode-path / residency telemetry
+    after (or during) consumption."""
+
+    def __init__(self, it, stream):
+        self._it = it
+        self.stream = stream
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
 
 
 @dataclasses.dataclass
@@ -432,6 +450,47 @@ class StreamingGameScorer:
             res = settle(done)
             if res is not None:
                 yield res
+
+    def score_container_stream(self, path, id_types, feature_shard_maps,
+                               batch_rows: int = 4096,
+                               add_intercept: bool = True,
+                               feeder: str = "auto",
+                               prefetch_depth: int = 2):
+        """End-to-end streamed scoring of Avro container input: yields
+        ``(dataset, scores)`` per decoded batch, in input order.
+
+        This is the full three-stage pipeline: the block-stream feeder
+        (data/block_stream.py — native C block decode, byte-identical
+        python fallback) decodes batch k+1 on its prefetch thread while
+        this engine's ``score_stream`` keeps batch k's H2D + dispatch in
+        flight (``InFlightWindow``). Host residency is bounded by
+        ``prefetch_depth + 2`` decoded batches (feeder) plus
+        ``pipeline_depth`` batches whose dispatch is in flight here.
+
+        Returns an iterator whose ``.stream`` attribute is the underlying
+        :class:`~photon_ml_tpu.data.block_stream.BlockGameStream`
+        (decode-path / residency telemetry).
+        """
+        from photon_ml_tpu.data.block_stream import BlockGameStream
+
+        stream = BlockGameStream(
+            path, id_types=id_types,
+            feature_shard_maps=feature_shard_maps, batch_rows=batch_rows,
+            add_intercept=add_intercept, feeder=feeder,
+            prefetch_depth=prefetch_depth)
+
+        def run():
+            held: deque = deque()  # batches whose dispatch is in flight
+
+            def feed():
+                for ds in stream:
+                    held.append(ds)
+                    yield ds
+
+            for scores in self.score_stream(feed()):
+                yield held.popleft(), scores
+
+        return _StreamScoring(run(), stream)
 
     # -- introspection -----------------------------------------------------
 

@@ -66,9 +66,30 @@ def pytest_configure(config):
         "markers",
         "needs_f64: test depends on double precision (finite differences, "
         "sub-1e-8 golden values) and is skipped in the f32 CI config")
+    config.addinivalue_line(
+        "markers",
+        "native_decoder: test exercises the native C Avro decoder "
+        "(photon_ml_tpu/native/_avro_native.c) and is skipped cleanly "
+        "when the extension is unbuilt (no C compiler) or disabled via "
+        "PHOTON_ML_TPU_NO_NATIVE=1")
+
+
+def _native_decoder_available() -> bool:
+    from photon_ml_tpu.native import load_avro_native
+
+    native = load_avro_native()
+    return native is not None and hasattr(native, "decode_training_block")
 
 
 def pytest_collection_modifyitems(config, items):
+    if any("native_decoder" in item.keywords for item in items) \
+            and not _native_decoder_available():
+        skip_native = pytest.mark.skip(
+            reason="native C avro decoder unavailable (extension unbuilt "
+                   "or PHOTON_ML_TPU_NO_NATIVE=1)")
+        for item in items:
+            if "native_decoder" in item.keywords:
+                item.add_marker(skip_native)
     if not F32_MODE:
         return
     skip = pytest.mark.skip(

@@ -1,0 +1,379 @@
+"""Block-streaming feeder (data/block_stream.py): byte-identity of the
+native C block path against the pure-python record loop (block-run
+boundaries never leak into batches), the bounded-residency prefetch
+contract, feeder selection/fallback, and the end-to-end streamed-scoring
+regression against one-shot `read_game_dataset` scoring."""
+
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.avro_reader import (
+    iter_game_dataset_batches,
+    read_game_dataset,
+)
+from photon_ml_tpu.data.block_stream import BlockGameStream
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import write_container
+
+
+def _write_stream_file(path, n, rng, n_features=40, per_row=5,
+                       sync_interval=1024, n_users=9, n_items=6,
+                       unknown_every=0):
+    """Many-block TrainingExampleAvro file; ``unknown_every`` > 0 plants
+    entity names no model vocabulary will contain every k-th record."""
+    recs = []
+    for i in range(n):
+        cols = rng.choice(n_features, size=per_row, replace=False)
+        user = (f"ghost{i}" if unknown_every and i % unknown_every == 0
+                else f"user{i % n_users}")
+        recs.append({
+            "uid": f"u{i}" if i % 3 else None,
+            "label": float(i % 2),
+            "features": [
+                {"name": f"f{c}", "term": "t" if c % 2 else None,
+                 "value": float(rng.normal())} for c in cols],
+            "weight": 2.0 if i % 5 == 0 else None,
+            "offset": 0.25 if i % 7 == 0 else None,
+            "metadataMap": {"userId": user, "itemId": f"item{i % n_items}"},
+        })
+    write_container(path, schemas.TRAINING_EXAMPLE, recs,
+                    sync_interval=sync_interval)
+    return recs
+
+
+def _assert_batches_identical(a, b):
+    assert np.array_equal(a.responses, b.responses)
+    assert np.array_equal(a.offsets, b.offsets)
+    assert np.array_equal(a.weights, b.weights)
+    assert a.responses.dtype == b.responses.dtype
+    assert (a.uids == b.uids).all()
+    assert set(a.feature_shards) == set(b.feature_shards)
+    for name in a.feature_shards:
+        ma, mb = a.feature_shards[name], b.feature_shards[name]
+        assert np.array_equal(ma.data, mb.data)
+        assert np.array_equal(ma.indices, mb.indices)
+        assert np.array_equal(ma.indptr, mb.indptr)
+    assert set(a.id_columns) == set(b.id_columns)
+    for t in a.id_columns:
+        assert np.array_equal(a.id_columns[t].codes, b.id_columns[t].codes)
+        assert np.array_equal(a.id_columns[t].vocabulary,
+                              b.id_columns[t].vocabulary)
+
+
+@pytest.fixture
+def stream_file(tmp_path, rng):
+    p = tmp_path / "stream.avro"
+    _write_stream_file(p, 1000, rng)
+    return p
+
+
+@pytest.fixture
+def shard_maps(stream_file):
+    from photon_ml_tpu.data.avro_reader import build_index_map
+
+    return {"global": build_index_map(stream_file, ingest_workers=1)}
+
+
+def _force_no_native(monkeypatch):
+    import photon_ml_tpu.native as nat
+
+    monkeypatch.setattr(nat, "_loaded", True)
+    monkeypatch.setattr(nat, "_module", None)
+
+
+@pytest.mark.native_decoder
+def test_native_batches_byte_identical_to_python(stream_file, shard_maps):
+    """batch_rows=37 never divides the ~85-record blocks, so every batch
+    boundary cuts through a block — and the cut must be invisible."""
+    native = BlockGameStream(stream_file, ["userId", "itemId"], shard_maps,
+                             batch_rows=37, feeder="native",
+                             prefetch_depth=2)
+    python = BlockGameStream(stream_file, ["userId", "itemId"], shard_maps,
+                             batch_rows=37, feeder="python")
+    bn, bp = list(native), list(python)
+    assert native.decode_path == "native"
+    assert python.decode_path == "python"
+    assert len(bn) == len(bp) == -(-1000 // 37)
+    assert [d.num_rows for d in bn] == [37] * (1000 // 37) + [1000 % 37]
+    for a, b in zip(bn, bp):
+        _assert_batches_identical(a, b)
+
+
+@pytest.mark.native_decoder
+def test_batches_concatenate_to_one_shot_dataset(stream_file, shard_maps):
+    whole, _ = read_game_dataset(stream_file, id_types=["userId"],
+                                 feature_shard_maps=shard_maps,
+                                 ingest_workers=1)
+    batches = list(BlockGameStream(stream_file, ["userId"], shard_maps,
+                                   batch_rows=129, feeder="native"))
+    assert sum(d.num_rows for d in batches) == whole.num_rows
+    np.testing.assert_array_equal(
+        np.concatenate([d.responses for d in batches]), whole.responses)
+    np.testing.assert_array_equal(
+        np.concatenate([d.offsets for d in batches]), whole.offsets)
+    np.testing.assert_array_equal(
+        np.concatenate([d.weights for d in batches]), whole.weights)
+    np.testing.assert_array_equal(
+        np.concatenate([d.uids for d in batches]), whole.uids)
+    m = sp.vstack([d.feature_shards["global"] for d in batches],
+                  format="csr")
+    w = whole.feature_shards["global"]
+    np.testing.assert_array_equal(m.data, w.data)
+    np.testing.assert_array_equal(m.indices, w.indices)
+    np.testing.assert_array_equal(m.indptr, w.indptr)
+    # Entity vocabularies are batch-local codes but the NAMES round-trip.
+    np.testing.assert_array_equal(
+        np.concatenate(
+            [d.id_columns["userId"].vocabulary[d.id_columns["userId"].codes]
+             for d in batches]),
+        whole.id_columns["userId"].vocabulary[
+            whole.id_columns["userId"].codes])
+
+
+def test_auto_falls_back_without_native(stream_file, shard_maps,
+                                        monkeypatch):
+    native_first = list(BlockGameStream(stream_file, ["userId"], shard_maps,
+                                        batch_rows=250))
+    _force_no_native(monkeypatch)
+    stream = BlockGameStream(stream_file, ["userId"], shard_maps,
+                             batch_rows=250)
+    assert stream.decode_path == "python"
+    fallback = list(stream)
+    assert len(fallback) == len(native_first)
+    for a, b in zip(native_first, fallback):
+        _assert_batches_identical(a, b)
+
+
+def test_feeder_native_raises_when_unavailable(stream_file, shard_maps,
+                                               monkeypatch):
+    _force_no_native(monkeypatch)
+    with pytest.raises(RuntimeError, match="native"):
+        BlockGameStream(stream_file, ["userId"], shard_maps,
+                        batch_rows=10, feeder="native")
+
+
+def test_validation_errors(stream_file, shard_maps):
+    with pytest.raises(ValueError, match="batch_rows"):
+        BlockGameStream(stream_file, [], shard_maps, batch_rows=0)
+    with pytest.raises(ValueError, match="feeder"):
+        BlockGameStream(stream_file, [], shard_maps, batch_rows=1,
+                        feeder="spark")
+    with pytest.raises(ValueError, match="batch_rows"):
+        next(iter_game_dataset_batches(stream_file, [], shard_maps,
+                                       batch_rows=-1))
+
+
+@pytest.mark.native_decoder
+def test_multi_file_stream_preserves_order(tmp_path, rng):
+    from photon_ml_tpu.data.avro_reader import build_index_map
+
+    p1, p2 = tmp_path / "a.avro", tmp_path / "b.avro"
+    _write_stream_file(p1, 300, rng)
+    _write_stream_file(p2, 170, rng)
+    imap = build_index_map([p1, p2], ingest_workers=1)
+    maps = {"global": imap}
+    whole, _ = read_game_dataset([p1, p2], id_types=["userId"],
+                                 feature_shard_maps=maps, ingest_workers=1)
+    # batch_rows chosen so one batch SPANS the file boundary.
+    batches = list(BlockGameStream([p1, p2], ["userId"], maps,
+                                   batch_rows=90, feeder="native"))
+    assert sum(d.num_rows for d in batches) == 470
+    np.testing.assert_array_equal(
+        np.concatenate([d.responses for d in batches]), whole.responses)
+    np.testing.assert_array_equal(
+        np.concatenate([d.uids for d in batches]), whole.uids)
+
+
+@pytest.mark.native_decoder
+def test_single_partial_batch_when_batch_rows_exceeds_input(stream_file,
+                                                            shard_maps):
+    batches = list(BlockGameStream(stream_file, ["userId"], shard_maps,
+                                   batch_rows=10_000, feeder="native"))
+    assert [d.num_rows for d in batches] == [1000]
+
+
+@pytest.mark.native_decoder
+def test_prefetch_peak_residency_bounded(stream_file, shard_maps):
+    """A deliberately slow consumer lets the prefetch thread run as far
+    ahead as it ever can; resident batches must stay bounded by
+    depth (queue) + 1 (producer's hand) + 1 (consumer's hand)."""
+    for depth in (1, 3):
+        stream = BlockGameStream(stream_file, ["userId"], shard_maps,
+                                 batch_rows=50, feeder="native",
+                                 prefetch_depth=depth)
+        got = 0
+        for _ in stream:
+            got += 1
+            if got <= 3:
+                # Give the producer ample time to fill the queue and
+                # block on it — the worst case for residency.
+                time.sleep(0.05)
+        assert got == 20
+        assert 0 < stream.peak_resident_batches <= depth + 2, \
+            stream.stats()
+
+
+@pytest.mark.native_decoder
+def test_corrupt_block_payload_names_file(tmp_path, rng, shard_maps):
+    from photon_ml_tpu.data.shard_planner import scan_container_blocks
+
+    p = tmp_path / "bad.avro"
+    _write_stream_file(p, 800, rng)
+    index = scan_container_blocks(p)
+    assert len(index.blocks) >= 3
+    block = index.blocks[1]
+    raw = bytearray(p.read_bytes())
+
+    def varint_len(off):
+        k = 0
+        while raw[off + k] & 0x80:
+            k += 1
+        return k + 1
+
+    payload_start = block.offset + varint_len(block.offset)
+    payload_start += varint_len(payload_start)
+    for i in range(8):
+        raw[payload_start + 4 + i] ^= 0xFF
+    p.write_bytes(bytes(raw))
+
+    stream = BlockGameStream(p, [], shard_maps, batch_rows=64,
+                             feeder="native", prefetch_depth=2)
+    with pytest.raises(ValueError, match="bad.avro"):
+        list(stream)
+
+
+# -- streamed scoring regression (the --stream contract) -------------------
+
+
+def _scoring_model_and_maps(rng):
+    """A device-scorable GAME model (fixed + per-user RE + MF) plus the
+    feature shard maps an Avro scoring input joins through."""
+    from photon_ml_tpu.data.game_data import GameDataset
+    from photon_ml_tpu.data.random_effect import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.models import (
+        Coefficients,
+        FixedEffectModel,
+        GameModel,
+        LogisticRegressionModel,
+        MatrixFactorizationModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    n, n_users, n_items = 90, 9, 6
+    x = rng.normal(0, 1, (n, 6))
+    user_x = np.hstack([rng.normal(0, 1, (n, 2)), np.ones((n, 1))])
+    train = GameDataset.build(
+        responses=(rng.random(n) < 0.5).astype(float),
+        feature_shards={"global": sp.csr_matrix(x),
+                        "user": sp.csr_matrix(user_x)},
+        ids={"userId": rng.integers(0, n_users, n).astype(str),
+             "itemId": rng.integers(0, n_items, n).astype(str)})
+    ds = build_random_effect_dataset(
+        train, RandomEffectDataConfiguration("userId", "user"),
+        intercept_col=2)
+    re = RandomEffectModel.zeros_like_dataset(ds, dtype=jnp.float64)
+    re = re.with_coefs([jnp.asarray(rng.normal(0, 1, np.asarray(c).shape))
+                        for c in re.local_coefs])
+    fe = FixedEffectModel(
+        LogisticRegressionModel(Coefficients(
+            jnp.asarray(rng.normal(0, 1, 6)))), "global")
+    mf = MatrixFactorizationModel(
+        "userId", "itemId",
+        jnp.asarray(rng.normal(0, 1, (n_users, 3))),
+        jnp.asarray(rng.normal(0, 1, (n_items, 3))),
+        np.unique(train.id_columns["userId"].vocabulary),
+        np.unique(train.id_columns["itemId"].vocabulary))
+    model = GameModel({"fixed": fe, "perUser": re, "mf": mf},
+                      TaskType.LOGISTIC_REGRESSION)
+    maps = {
+        "global": IndexMap({feature_key(f"g{j}"): j for j in range(6)}),
+        "user": IndexMap({feature_key(f"w{j}"): j for j in range(3)}),
+    }
+    return model, maps
+
+
+def _write_scoring_file(path, rng, n=140, n_users=9, n_items=6):
+    recs = []
+    for i in range(n):
+        feats = [{"name": f"g{j}", "term": None,
+                  "value": float(rng.normal())} for j in range(6)]
+        feats += [{"name": f"w{j}", "term": None,
+                   "value": float(rng.normal())} for j in range(3)]
+        # ~1 in 6 rows carries an entity no model vocabulary contains —
+        # it must score exactly 0 on RE/MF terms, streamed or not.
+        user = f"ghost{i}" if i % 6 == 0 else f"user{i % n_users}"
+        recs.append({
+            "uid": f"r{i}", "label": float(i % 2), "features": feats,
+            "weight": None, "offset": 0.5 if i % 4 == 0 else None,
+            "metadataMap": {"userId": user, "itemId": f"item{i % n_items}"},
+        })
+    write_container(path, schemas.TRAINING_EXAMPLE, recs,
+                    sync_interval=512)  # many small blocks
+
+
+@pytest.mark.native_decoder
+@pytest.mark.needs_f64
+def test_streamed_scoring_byte_identical_to_one_shot(tmp_path, rng):
+    """--stream's pipeline (C feeder, prefetch on) must reproduce one-shot
+    `read_game_dataset` + engine scoring BYTE-identically, including
+    across block-run boundaries and with unknown entities in-stream."""
+    from photon_ml_tpu.serving import BucketLadder, StreamingGameScorer
+
+    model, maps = _scoring_model_and_maps(rng)
+    p = tmp_path / "score.avro"
+    _write_scoring_file(p, rng)
+
+    engine = StreamingGameScorer(model, dtype=jnp.float64,
+                                 ladder=BucketLadder(min_rows=8,
+                                                     max_rows=64))
+    scored = engine.score_container_stream(
+        p, id_types=["userId", "itemId"], feature_shard_maps=maps,
+        batch_rows=33, feeder="native", prefetch_depth=2)
+    streamed_scores, streamed_rows = [], 0
+    for ds, scores in scored:
+        assert len(scores) == ds.num_rows
+        streamed_scores.append(scores)
+        streamed_rows += ds.num_rows
+    assert scored.stream.decode_path == "native"
+    assert streamed_rows == 140
+
+    whole, _ = read_game_dataset(p, id_types=["userId", "itemId"],
+                                 feature_shard_maps=maps, ingest_workers=1)
+    one_shot = engine.score(whole)
+    np.testing.assert_array_equal(np.concatenate(streamed_scores),
+                                  one_shot)
+
+
+@pytest.mark.needs_f64
+def test_streamed_scoring_python_feeder_matches_native_path(tmp_path, rng,
+                                                            monkeypatch):
+    """The same scoring stream through the python fallback produces the
+    same bytes — the feeder choice can never change a score."""
+    from photon_ml_tpu.serving import BucketLadder, StreamingGameScorer
+
+    model, maps = _scoring_model_and_maps(rng)
+    p = tmp_path / "score.avro"
+    _write_scoring_file(p, rng)
+    engine = StreamingGameScorer(model, dtype=jnp.float64,
+                                 ladder=BucketLadder(min_rows=8,
+                                                     max_rows=64))
+
+    def scores_with(feeder, prefetch):
+        out = [s for _, s in engine.score_container_stream(
+            p, id_types=["userId", "itemId"], feature_shard_maps=maps,
+            batch_rows=33, feeder=feeder, prefetch_depth=prefetch)]
+        return np.concatenate(out)
+
+    auto = scores_with("auto", 2)
+    python = scores_with("python", 0)
+    np.testing.assert_array_equal(auto, python)

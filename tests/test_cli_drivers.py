@@ -253,6 +253,27 @@ def test_game_scoring_stream_matches_batch(tmp_path, rng):
     # engine telemetry rode along: compile cache stayed small
     assert stream["engine"]["compilations"] <= \
         stream["engine"]["dispatches"]
+    # feeder telemetry: decode path + bounded residency (prefetch default 2)
+    feeder = stream["feeder"]
+    assert feeder["decode_path"] in ("native", "python")
+    assert feeder["batches"] == 5
+    assert feeder["rows"] == 140
+    assert feeder["peak_resident_batches"] <= feeder["prefetch_depth"] + 2
+
+    # The forced-python feeder (no prefetch) writes the SAME bytes — the
+    # decode path can never change a score.
+    py_out = tmp_path / "score-stream-py"
+    py = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(model_dir),
+        "--output-dir", str(py_out),
+        "--stream", "--batch-rows", "33",
+        "--feeder", "python", "--prefetch-batches", "0",
+    ])
+    assert py["feeder"]["decode_path"] == "python"
+    recs_p = list(read_container(py_out / "scores" / "part-00000.avro"))
+    assert [(r["uid"], r["predictionScore"]) for r in recs_p] == \
+        [(r["uid"], r["predictionScore"]) for r in recs_s]
 
 
 def test_game_scoring_host_fallback_on_unsupported_model(

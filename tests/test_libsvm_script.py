@@ -1,0 +1,141 @@
+"""dev_scripts/libsvm_text_to_trainingexample_avro.py: round-trip a small
+LibSVM text file into TrainingExampleAvro and decode it back through BOTH
+container readers — the pure-python datum decoder and the native C block
+decoder — plus the training ingest fast path."""
+
+import importlib.util
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.avro_codec import read_container
+
+_SCRIPT = (Path(__file__).resolve().parents[1] / "dev_scripts"
+           / "libsvm_text_to_trainingexample_avro.py")
+
+
+def _load_script():
+    spec = importlib.util.spec_from_file_location("libsvm_script", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def script():
+    return _load_script()
+
+
+LIBSVM_TEXT = """\
++1 1:0.5 3:-1.25 7:2.0  # trailing comment
+-1 2:1.0 7:0.125
+# full-line comment
+
++1 1:-3.5
+-1 5:4.0 6:-0.75
+"""
+
+
+@pytest.fixture
+def converted(tmp_path, script):
+    src = tmp_path / "data.libsvm"
+    src.write_text(LIBSVM_TEXT)
+    out = tmp_path / "avro-out"
+    n = script.convert(src, out, regression=False, zero_based=False)
+    assert n == 4
+    return out / "part-00000.avro"
+
+
+def _expected_rows():
+    # 1-based input indices -> 0-based names; -1/+1 -> 0/1 labels.
+    return [
+        (1.0, {"0": 0.5, "2": -1.25, "6": 2.0}),
+        (0.0, {"1": 1.0, "6": 0.125}),
+        (1.0, {"0": -3.5}),
+        (0.0, {"4": 4.0, "5": -0.75}),
+    ]
+
+
+def _decode(path):
+    recs = list(read_container(path))
+    return [(r["label"],
+             {f["name"]: f["value"] for f in r["features"]},
+             r["uid"], r["weight"], r["offset"], r["metadataMap"])
+            for r in recs]
+
+
+def test_python_reader_roundtrip(converted, monkeypatch):
+    import photon_ml_tpu.native as nat
+
+    monkeypatch.setattr(nat, "_loaded", True)
+    monkeypatch.setattr(nat, "_module", None)
+    rows = _decode(converted)
+    for (label, feats), (got_label, got_feats, uid, w, off, meta) in zip(
+            _expected_rows(), rows):
+        assert got_label == label
+        assert got_feats == feats
+        assert uid is not None  # line numbers become uids
+        assert w is None and off is None and meta is None
+
+
+@pytest.mark.native_decoder
+def test_c_reader_matches_python_reader(converted, monkeypatch):
+    import photon_ml_tpu.native as nat
+
+    native_rows = _decode(converted)  # C decode_block path
+    saved = (nat._loaded, nat._module)
+    try:
+        nat._loaded, nat._module = True, None
+        python_rows = _decode(converted)
+    finally:
+        nat._loaded, nat._module = saved
+    assert native_rows == python_rows
+    assert [r[0] for r in native_rows] == [1.0, 0.0, 1.0, 0.0]
+
+
+def test_training_ingest_reads_converted_file(converted):
+    from photon_ml_tpu.data.avro_reader import read_labeled_points
+
+    mat, labels, offsets, weights, uids, imap = read_labeled_points(
+        converted, add_intercept=False, ingest_workers=1)
+    np.testing.assert_array_equal(labels, [1.0, 0.0, 1.0, 0.0])
+    np.testing.assert_array_equal(offsets, np.zeros(4))
+    np.testing.assert_array_equal(weights, np.ones(4))
+    assert uids == ["1", "2", "5", "6"]  # source line numbers
+    dense = {}
+    for i in range(4):
+        row = mat[i]
+        for j, v in zip(row.indices, row.data):
+            dense[(i, imap.get_feature_name(j))] = v
+    assert dense[(0, "0\x01")] == 0.5
+    assert dense[(3, "5\x01")] == -0.75
+    assert mat.nnz == 8
+
+
+def test_regression_and_zero_based_flags(tmp_path, script):
+    src = tmp_path / "reg.libsvm"
+    src.write_text("2.5 0:1.0 3:2.0\n-4.25 1:0.5\n")
+    out = tmp_path / "reg-out"
+    n = script.convert(src, out, regression=True, zero_based=True)
+    assert n == 2
+    rows = _decode(out / "part-00000.avro")
+    assert [r[0] for r in rows] == [2.5, -4.25]  # raw labels kept
+    assert rows[0][1] == {"0": 1.0, "3": 2.0}  # indices used as-is
+
+
+def test_malformed_line_is_a_clean_error(tmp_path, script):
+    src = tmp_path / "bad.libsvm"
+    src.write_text("+1 1:0.5\n-1 notafeature\n")
+    out = tmp_path / "bad-out"
+    with pytest.raises(SystemExit, match="bad.libsvm:2"):
+        script.convert(src, out, regression=False, zero_based=False)
+
+
+def test_main_entrypoint(tmp_path, script, capsys):
+    src = tmp_path / "m.libsvm"
+    src.write_text("+1 1:1.0\n")
+    out = tmp_path / "m-out"
+    script.main([str(src), str(out)])
+    assert "wrote 1 records" in capsys.readouterr().out
+    assert (out / "part-00000.avro").exists()
