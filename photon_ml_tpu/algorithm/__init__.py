@@ -5,6 +5,7 @@ from photon_ml_tpu.algorithm.coordinates import (
     FactoredRandomEffectCoordinate,
     FixedEffectCoordinate,
     RandomEffectCoordinate,
+    StreamingFixedEffectCoordinate,
 )
 from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
 
@@ -13,5 +14,6 @@ __all__ = [
     "FactoredRandomEffectCoordinate",
     "FixedEffectCoordinate",
     "RandomEffectCoordinate",
+    "StreamingFixedEffectCoordinate",
     "CoordinateDescent",
 ]

@@ -563,7 +563,11 @@ class CoordinateDescent:
             return cached
         first = self.coordinates[list(self.coordinates)[0]]
         data = getattr(first, "data", None)
-        if isinstance(data, GameDataset):
+        if isinstance(data, GameDataset) or hasattr(data, "responses"):
+            # GameDataset (host f64 columns) or a streamed-ingest shim
+            # (data/shard_cache.StreamedFixedEffectData — device f32
+            # columns, for which the asarray cast is a no-op and the
+            # values match the one-shot cast bit for bit).
             rows = (jnp.asarray(data.responses, dtype),
                     jnp.asarray(data.offsets, dtype),
                     jnp.asarray(data.weights, dtype))

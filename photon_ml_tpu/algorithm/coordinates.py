@@ -278,6 +278,117 @@ class FixedEffectCoordinate(Coordinate):
 
 
 @dataclasses.dataclass
+class StreamingFixedEffectCoordinate:
+    """Out-of-core fixed-effect solver over a device shard cache — the
+    spill-mode (`--hbm-budget`) counterpart of FixedEffectCoordinate.
+
+    Where FixedEffectCoordinate holds ONE device batch and solves inside
+    a fused `lax.while_loop`, this coordinate accumulates (value,
+    gradient, Hessian-vector) per-shard over a
+    :class:`~photon_ml_tpu.data.shard_cache.DeviceShardCache`
+    (ops/sharded_objective.py) and drives the solve from the host
+    (optimization/glm_lbfgs.py `minimize_lbfgs_glm_streaming` /
+    optimization/tron.py `minimize_tron_streaming`) — the treeAggregate
+    shape of the reference's distributed solve, with HBM as the
+    partition cache tier.
+
+    Scope (enforced): L-BFGS or TRON with L2 only — no L1/OWL-QN, box
+    constraints, normalization context, or down-sampling (< 1.0). Those
+    configurations stream-train through the resident assembled path,
+    which reuses the full one-shot machinery.
+    """
+
+    name: str
+    cache: object  # DeviceShardCache
+    feature_shard_id: str
+    task_type: TaskType
+    config: GLMOptimizationConfiguration
+    dtype: object = jnp.float32
+    tracing_guard: Optional[object] = None
+    # Reuse a previously built ShardedGLMObjective (λ-grid sweeps: the l2
+    # weight is a traced argument, so sharing the objective shares every
+    # compiled accumulate kernel across grid points — the same
+    # no-recompile contract as the resident solvers).
+    sharded_objective: Optional[object] = None
+
+    def __post_init__(self):
+        from photon_ml_tpu.optimization.config import OptimizerType
+        from photon_ml_tpu.ops.sharded_objective import ShardedGLMObjective
+
+        l1, l2 = _l1_l2(self.config)
+        if l1 > 0:
+            raise ValueError(
+                "streaming fixed-effect solves support L2 only; "
+                "L1/elastic-net needs the resident (assembled) path")
+        if self.config.down_sampling_rate < 1.0:
+            raise ValueError(
+                "down-sampling is not supported with --hbm-budget "
+                "streaming solves (per-row randomness is defined on the "
+                "full batch); use the resident path")
+        if self.config.optimizer_type not in (OptimizerType.LBFGS,
+                                              OptimizerType.TRON):
+            raise ValueError(
+                f"streaming fixed-effect solves support LBFGS/TRON, got "
+                f"{self.config.optimizer_type}")
+        self._l2 = l2
+        if self.sharded_objective is not None:
+            if self.sharded_objective.cache is not self.cache:
+                raise ValueError(
+                    "shared sharded_objective must wrap the same cache")
+            self._sharded = self.sharded_objective
+            self._objective = self._sharded.objective
+        else:
+            self._objective = GLMObjective(loss_for_task(self.task_type))
+            self._sharded = ShardedGLMObjective(
+                self._objective, self.cache,
+                tracing_guard=self.tracing_guard)
+            # Expose the built objective through the same field callers
+            # pass it back in with (grid sweeps share compiled kernels).
+            self.sharded_objective = self._sharded
+
+    def initialize_model(self) -> FixedEffectModel:
+        from photon_ml_tpu.models.coefficients import Coefficients
+
+        glm_cls = model_for_task(self.task_type)
+        return FixedEffectModel(
+            glm_cls(Coefficients.zeros(self.cache.n_features, self.dtype)),
+            self.feature_shard_id)
+
+    def solve(self, model: Optional[FixedEffectModel] = None
+              ) -> Tuple[FixedEffectModel, OptimizerResult]:
+        """One full-batch GLM solve by streamed accumulation (warm-started
+        from ``model`` when given)."""
+        from photon_ml_tpu.optimization.config import OptimizerType
+        from photon_ml_tpu.optimization.glm_lbfgs import (
+            minimize_lbfgs_glm_streaming,
+        )
+        from photon_ml_tpu.optimization.tron import minimize_tron_streaming
+
+        if model is None:
+            model = self.initialize_model()
+        coef0 = jnp.asarray(model.glm.coefficients.means, self.dtype)
+        if self.config.optimizer_type == OptimizerType.TRON:
+            if not self._objective.loss.twice_differentiable:
+                raise ValueError(
+                    f"TRON requires a twice-differentiable loss, got "
+                    f"{self._objective.loss.name}")
+            result = minimize_tron_streaming(
+                self._sharded, coef0, self._l2,
+                max_iter=self.config.max_iterations,
+                tol=self.config.tolerance)
+        else:
+            result = minimize_lbfgs_glm_streaming(
+                self._sharded, coef0, self._l2,
+                max_iter=self.config.max_iterations,
+                tol=self.config.tolerance)
+        self._sharded.assert_trace_budget()
+        from photon_ml_tpu.models.coefficients import Coefficients
+
+        new_glm = model.glm.update_coefficients(Coefficients(result.x))
+        return model.update_model(new_glm), result
+
+
+@dataclasses.dataclass
 class RandomEffectCoordinate(Coordinate):
     """Entity-sharded coordinate
     (ml/algorithm/RandomEffectCoordinate.scala:36-201).

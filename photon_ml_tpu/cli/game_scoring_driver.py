@@ -196,7 +196,6 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
     requested) accumulate across batches — never features — so metrics
     cost O(total rows) of scalars/id strings while feature memory stays
     O(batch_rows x (prefetch + pipeline depth))."""
-    from photon_ml_tpu.data.game_data import GameDataset
     from photon_ml_tpu.serving import StreamingGameScorer
 
     try:
@@ -214,22 +213,17 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
         raise SystemExit(str(e)) from e
     logger.info("streamed scoring: %s feeder, prefetch depth %d",
                 scored.stream.decode_path, scored.stream.prefetch_depth)
+    from photon_ml_tpu.evaluation.validation import StreamedEvalAccumulator
+
     counters = {"rows": 0, "batches": 0}
-    acc = {"scores": [], "responses": [], "offsets": [], "weights": [],
-           "ids": {t: [] for t in id_types}} if evaluators else None
+    acc = StreamedEvalAccumulator(id_types) if evaluators else None
 
     def scored_records():
         for ds, scores in scored:
             counters["rows"] += ds.num_rows
             counters["batches"] += 1
             if acc is not None:
-                acc["scores"].append(scores)
-                acc["responses"].append(ds.responses)
-                acc["offsets"].append(ds.offsets)
-                acc["weights"].append(ds.weights)
-                for t in id_types:
-                    col = ds.id_columns[t]
-                    acc["ids"][t].append(col.vocabulary[col.codes])
+                acc.add(ds, scores)
             uids = ds.uids if ds.uids is not None else \
                 np.asarray([str(i) for i in range(ds.num_rows)])
             for u, s, o, l in zip(uids, scores, ds.offsets, ds.responses):
@@ -240,17 +234,7 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
     logger.info("scored %d rows in %d streamed batches (batch-rows=%d)",
                 counters["rows"], counters["batches"], args.batch_rows)
 
-    metrics = {}
-    if evaluators and acc["scores"]:
-        eval_data = GameDataset.build(
-            responses=np.concatenate(acc["responses"]),
-            feature_shards={},
-            ids={t: np.concatenate(v) for t, v in acc["ids"].items()},
-            offsets=np.concatenate(acc["offsets"]),
-            weights=np.concatenate(acc["weights"]))
-        scores_all = np.concatenate(acc["scores"])
-        metrics = {ev.name: ev.evaluate_dataset(scores_all, eval_data)
-                   for ev in evaluators}
+    metrics = acc.metrics(evaluators) if acc is not None else {}
     return {
         "numRows": counters["rows"],
         "metrics": metrics,

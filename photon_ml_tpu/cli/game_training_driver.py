@@ -57,6 +57,26 @@ def _positive_int(s: str) -> int:
     return v
 
 
+_BYTE_SUFFIXES = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30, "T": 1 << 40}
+
+
+def parse_byte_size(s: str) -> int:
+    """'512M', '8G', '1048576' -> bytes."""
+    s = s.strip().upper().removesuffix("B")
+    mult = 1
+    if s and s[-1] in _BYTE_SUFFIXES:
+        mult = _BYTE_SUFFIXES[s[-1]]
+        s = s[:-1]
+    try:
+        v = int(float(s) * mult)
+    except (ValueError, OverflowError):  # OverflowError: 'inf', '1e999'
+        raise argparse.ArgumentTypeError(
+            f"bad byte size {s!r} (expected e.g. 512M, 8G, 1048576)")
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"byte size must be >= 1, got {v}")
+    return v
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="photon-game-training-driver",
@@ -117,6 +137,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save-all-models", default="false",
                    choices=["true", "false"],
                    help="model-output-mode ALL vs BEST")
+    p.add_argument("--stream-train", action="store_true",
+                   help="out-of-core training: ingest the training Avro "
+                        "through the block-streaming C-decoded pipeline "
+                        "in --batch-rows batches (host memory stays "
+                        "O(batch)) instead of one-shot-materializing it. "
+                        "Without --hbm-budget the shards assemble into "
+                        "the exact one-shot device batch (byte-identical "
+                        "model, fused solvers); with --hbm-budget the "
+                        "solve streams over a device shard cache with "
+                        "replay-aware spill. Supports a single "
+                        "fixed-effect "
+                        "coordinate")
+    p.add_argument("--batch-rows", type=_positive_int, default=4096,
+                   help="rows per streamed ingest batch (and per cached "
+                        "device shard in --hbm-budget mode)")
+    p.add_argument("--hbm-budget", default=None, metavar="BYTES",
+                   type=parse_byte_size,
+                   help="device-memory budget for cached feature blocks "
+                        "(e.g. 512M, 8G): furthest-next-use shards "
+                        "spill to host column buffers and re-upload "
+                        "overlapped with the accumulate. Selects the "
+                        "sharded streaming solve (L2 LBFGS/TRON only)")
+    p.add_argument("--feeder", choices=["auto", "native", "python"],
+                   default="auto",
+                   help="--stream-train decode path (see "
+                        "data/block_stream.py); 'python' forces the "
+                        "byte-identical record-loop fallback")
+    p.add_argument("--prefetch-batches", type=int, default=2,
+                   help="decode-ahead depth of the --stream-train feeder "
+                        "(and spill re-upload look-ahead); 0 disables")
     return p
 
 
@@ -176,6 +226,38 @@ def run(argv=None) -> dict:
         args.train_input_dirs,
         date_range=args.train_date_range,
         date_range_days_ago=args.train_date_range_days_ago)
+
+    def parse_grid(s: str):
+        return [GLMOptimizationConfiguration.parse(part)
+                for part in s.split("|")]
+
+    def opt_grid(table, name, flag):
+        if name not in table:
+            raise ValueError(
+                f"coordinate {name!r} has no optimization configuration — "
+                f"pass it via {flag} (have {sorted(table) or 'none'})")
+        return parse_grid(table[name])
+
+    evaluators = [build_evaluator(s.strip())
+                  for s in (args.evaluators or "").split(",") if s.strip()]
+
+    if args.stream_train:
+        if re_data or fre_data or len(sequence) != 1 \
+                or sequence[0] not in fe_data:
+            raise ValueError(
+                "--stream-train supports exactly one fixed-effect "
+                "coordinate (random/factored effects need entity "
+                f"grouping over the full dataset); got sequence "
+                f"{sequence}")
+        with maybe_trace(args.profile_output_dir):
+            (results, best_configs, best_result, shard_maps, num_rows,
+             stream_info) = _stream_train(
+                args, logger, task, fe_data, fe_opt, sequence,
+                train_inputs, evaluators, preloaded_maps, opt_grid)
+        return _finish(args, out_dir, logger, task, sequence, t0, results,
+                       best_configs, best_result, shard_maps, num_rows,
+                       stream_info)
+
     logger.info("reading training data from %s (ingest workers: %s)",
                 train_inputs, args.ingest_workers)
     data, shard_maps = read_game_dataset(train_inputs, id_types=id_types,
@@ -191,17 +273,6 @@ def run(argv=None) -> dict:
             validate_inputs, id_types=id_types,
             feature_shard_maps=shard_maps,
             ingest_workers=args.ingest_workers)
-
-    def parse_grid(s: str):
-        return [GLMOptimizationConfiguration.parse(part)
-                for part in s.split("|")]
-
-    def opt_grid(table, name, flag):
-        if name not in table:
-            raise ValueError(
-                f"coordinate {name!r} has no optimization configuration — "
-                f"pass it via {flag} (have {sorted(table) or 'none'})")
-        return parse_grid(table[name])
 
     specs = []
     for name in sequence:
@@ -247,9 +318,6 @@ def run(argv=None) -> dict:
                 intercept_col=(imap.intercept_index
                                if imap.intercept_index >= 0 else None)))
 
-    evaluators = [build_evaluator(s.strip())
-                  for s in (args.evaluators or "").split(",") if s.strip()]
-
     estimator = GameEstimator(
         task_type=task, coordinate_specs=specs,
         num_iterations=args.num_iterations,
@@ -261,7 +329,17 @@ def run(argv=None) -> dict:
                             if args.checkpoint_dir else None),
             checkpoint_interval=args.checkpoint_interval)
     best_configs, best_result = estimator.select_best(results)
+    return _finish(args, out_dir, logger, task, sequence, t0, results,
+                   best_configs, best_result, shard_maps,
+                   int(data.num_rows), None)
 
+
+def _finish(args, out_dir, logger, task, sequence, t0, results,
+            best_configs, best_result, shard_maps, num_rows,
+            stream_info) -> dict:
+    """Model save + metrics.json — shared by the one-shot and
+    --stream-train paths (identical artifacts either way, plus the
+    streaming telemetry block when streaming)."""
     from photon_ml_tpu.models.tracking import summarize_trackers
 
     # Aggregate per-entity optimizer telemetry (convergence-reason counts,
@@ -303,7 +381,7 @@ def run(argv=None) -> dict:
 
     summary = {
         "taskType": task.value,
-        "numRows": int(data.num_rows),
+        "numRows": num_rows,
         "updatingSequence": sequence,
         "numCombos": len(results),
         "bestConfigs": {k: v.to_string() for k, v in best_configs.items()},
@@ -312,9 +390,180 @@ def run(argv=None) -> dict:
         "coordinateSeconds": best_result.timings,
         "totalSeconds": time.perf_counter() - t0,
     }
+    if stream_info is not None:
+        summary["streamTrain"] = stream_info
     (out_dir / "metrics.json").write_text(json.dumps(summary, indent=2))
     logger.info("GAME training done in %.1fs", summary["totalSeconds"])
     return summary
+
+
+def _stream_validate_many(game_models, args, shard_maps, evaluators,
+                          logger):
+    """Bounded-memory validation of ALL grid models in ONE decode pass:
+    the validation container streams once (`BlockGameStream`,
+    `--batch-rows` batches) and every model's serving engine scores each
+    decoded batch, accumulating ONLY the evaluation columns
+    (`StreamedEvalAccumulator` — shared with the scoring driver's
+    --stream path) — never features. A G-point grid therefore costs one
+    decode + G scores per batch, not G full decode passes. An empty
+    validation input yields empty metric dicts."""
+    from photon_ml_tpu.data.block_stream import BlockGameStream
+    from photon_ml_tpu.evaluation.validation import StreamedEvalAccumulator
+    from photon_ml_tpu.serving import StreamingGameScorer
+
+    validate_inputs = resolve_input_dirs(
+        args.validate_input_dirs,
+        date_range=args.validate_date_range,
+        date_range_days_ago=args.validate_date_range_days_ago)
+    id_types = sorted({ev.id_type for ev in evaluators
+                       if getattr(ev, "id_type", None)})
+    engines = [StreamingGameScorer(m) for m in game_models]
+    accs = [StreamedEvalAccumulator(id_types) for _ in game_models]
+    stream = BlockGameStream(
+        validate_inputs, id_types=id_types, feature_shard_maps=shard_maps,
+        batch_rows=args.batch_rows, feeder=args.feeder,
+        prefetch_depth=max(0, args.prefetch_batches))
+    for ds in stream:
+        for engine, acc in zip(engines, accs):
+            acc.add(ds, engine.score(ds))
+    metrics = [acc.metrics(evaluators) for acc in accs]
+    logger.info("streamed validation (%d rows, %s feeder, %d models): %s",
+                stream.rows, stream.decode_path, len(engines), metrics)
+    return metrics
+
+
+def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
+                  train_inputs, evaluators, preloaded_maps, opt_grid):
+    """Out-of-core training path (--stream-train): block-streamed ingest
+    (host memory O(batch_rows)) into either
+
+    - the EXACT assembled device batch + the untouched fused solvers
+      (no --hbm-budget; model bytes identical to the one-shot driver), or
+    - a DeviceShardCache + sharded streaming accumulate solve
+      (--hbm-budget; replay-aware feature-block spill, deterministic
+      partials — resident and eviction-forced runs write identical
+      bytes).
+
+    Validation (when requested) streams through the serving engine in
+    both modes."""
+    import time as _time
+
+    from photon_ml_tpu.algorithm.coordinate_descent import (
+        CoordinateDescentResult,
+    )
+    from photon_ml_tpu.algorithm.coordinates import (
+        StreamingFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.data.avro_reader import build_index_map
+    from photon_ml_tpu.data.block_stream import BlockGameStream
+    from photon_ml_tpu.data.shard_cache import (
+        DeviceShardCache,
+        assemble_fixed_effect_batch,
+    )
+    from photon_ml_tpu.models.game_model import GameModel
+
+    name = sequence[0]
+    shard = fe_data[name]
+    grid = opt_grid(fe_opt, name,
+                    "--fixed-effect-optimization-configurations")
+    if preloaded_maps is not None:
+        if shard not in preloaded_maps:
+            raise ValueError(
+                f"fixed-effect coordinate {name!r} references unknown "
+                f"feature shard {shard!r} "
+                f"(have {sorted(preloaded_maps)})")
+        shard_maps = {shard: preloaded_maps[shard]}
+    else:
+        logger.info("building feature index for shard %r from %s",
+                    shard, train_inputs)
+        shard_maps = {shard: build_index_map(
+            train_inputs, ingest_workers=args.ingest_workers)}
+
+    def make_stream():
+        return BlockGameStream(
+            train_inputs, id_types=[], feature_shard_maps=shard_maps,
+            batch_rows=args.batch_rows, feeder=args.feeder,
+            prefetch_depth=max(0, args.prefetch_batches))
+
+    budget = args.hbm_budget  # parsed to bytes by argparse
+    if args.checkpoint_dir and budget is not None:
+        logger.warning("--checkpoint-dir is not supported with "
+                       "--hbm-budget streaming solves; ignoring")
+
+    if budget is None:
+        # -- resident: exact assembly + the one-shot estimator ------------
+        logger.info("stream-train (resident): assembling %r from %s in "
+                    "%d-row batches", shard, train_inputs, args.batch_rows)
+        data = assemble_fixed_effect_batch(make_stream(), shard)
+        estimator = GameEstimator(
+            task_type=task,
+            coordinate_specs=[FixedEffectSpec(
+                name=name, feature_shard_id=shard, configs=grid)],
+            num_iterations=args.num_iterations,
+            validation_evaluators=evaluators)
+        results = estimator.fit(
+            data, validation_data=None,
+            checkpoint_dir=(Path(args.checkpoint_dir)
+                            if args.checkpoint_dir else None),
+            checkpoint_interval=args.checkpoint_interval)
+        num_rows = data.num_rows
+        stream_info = {
+            "mode": "resident-assembled",
+            "batchRows": args.batch_rows,
+            "hbmBudgetBytes": None,
+            "feeder": {k: v for k, v in data.ingest_stats.items()},
+            "cache": None,
+        }
+    else:
+        # -- spill: sharded streaming accumulate over the device cache ----
+        logger.info("stream-train (spill, hbm budget %d bytes): caching "
+                    "%r from %s in %d-row shards", budget, shard,
+                    train_inputs, args.batch_rows)
+        cache = DeviceShardCache.from_stream(
+            make_stream(), shard, hbm_budget_bytes=budget,
+            prefetch_depth=max(0, args.prefetch_batches))
+        results = []
+        shared = None
+        for cfg in grid:
+            coord = StreamingFixedEffectCoordinate(
+                name=name, cache=cache, feature_shard_id=shard,
+                task_type=task, config=cfg, sharded_objective=shared)
+            shared = coord.sharded_objective
+            t0 = _time.perf_counter()
+            model, trackers, obj_hist = None, [], []
+            for _ in range(args.num_iterations):
+                model, res = coord.solve(model)
+                trackers.append(res)
+                obj_hist.append(float(res.value))
+            gm = GameModel({name: model}, task)
+            results.append(({name: cfg}, CoordinateDescentResult(
+                model=gm, objective_history=obj_hist,
+                validation_history=[], best_model=gm, best_metric=None,
+                trackers={name: trackers},
+                timings={name: _time.perf_counter() - t0})))
+        num_rows = cache.n_rows
+        stream_info = {
+            "mode": "spill",
+            "batchRows": args.batch_rows,
+            "hbmBudgetBytes": budget,
+            "feeder": cache.ingest_stats,
+            "cache": cache.stats(),
+            "traceBudgets": shared.trace_budgets(),
+            "traceCounts": shared.guard.counts(),
+        }
+
+    if args.validate_input_dirs and evaluators:
+        all_metrics = _stream_validate_many(
+            [res.model for _, res in results], args, shard_maps,
+            evaluators, logger)
+        for (_, res), metrics in zip(results, all_metrics):
+            res.validation_history.append(metrics)
+
+    from photon_ml_tpu.estimators.game_estimator import select_best_result
+
+    best_configs, best_result = select_best_result(results, evaluators)
+    return (results, best_configs, best_result, shard_maps, num_rows,
+            stream_info)
 
 
 def main() -> None:

@@ -320,3 +320,29 @@ class BlockGameStream:
             "rows": self.rows,
             "peak_resident_batches": self.peak_resident_batches,
         }
+
+
+def read_game_dataset_via_blocks(
+    path, id_types: Sequence[str],
+    feature_shard_maps: Dict[str, IndexMap],
+    add_intercept: bool = True,
+) -> Optional[GameDataset]:
+    """One-shot GAME read through the C BLOCK decoder: the whole container
+    decoded as one `BlockGameStream` batch (byte-identical to the record
+    paths — the same `_ColumnBuffer.take` contract the per-batch identity
+    tests pin down). This is `read_game_dataset`'s single-process fast
+    path: the block decode runs ~3x the generic C datum-decode record
+    loop (BENCH_full.json `extra.stream_scoring`), and it makes the block
+    path the ONE C decode implementation for both streamed and one-shot
+    reads. Returns None when the native path does not apply (extension
+    unbuilt, schema mismatch) — callers fall back as before."""
+    stream = BlockGameStream(
+        path, id_types=id_types, feature_shard_maps=feature_shard_maps,
+        batch_rows=2 ** 62, add_intercept=add_intercept,
+        feeder="auto", prefetch_depth=0)
+    if stream.decode_path != "native":
+        return None
+    out = None
+    for ds in stream:  # batch_rows spans the input: at most one batch
+        out = ds
+    return out

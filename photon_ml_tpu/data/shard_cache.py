@@ -1,0 +1,456 @@
+"""Device-resident shard cache for out-of-core streaming TRAINING.
+
+PRs 1 and 4 built a C-block-decoding, prefetched, chunked-H2D streaming
+pipeline that only SCORING used; training still one-shot-materialized the
+whole dataset on host and device (`read_game_dataset` ->
+`fixed_effect_batch`), capping trainable dataset size at host RAM. This
+module is the training-side consumer of that pipeline
+(Snap ML's pipelined chunk streaming with a device-resident working set,
+PAPERS.md): a `BlockGameStream` is consumed ONCE, batch by batch, and its
+rows land on device in one of two regimes —
+
+- **exact assembly** (`assemble_fixed_effect_batch`): each batch's CSR
+  slice uploads as it decodes (host residency stays O(batch_rows)) and
+  the device pieces concatenate into arrays BITWISE-identical to what
+  `GameDataset.fixed_effect_batch` builds from a one-shot read (CSR cuts
+  are row-contiguous, so values/col_ids/row_ids are literal slices of the
+  one-shot arrays; casts are elementwise). The untouched fused
+  `lax.while_loop` solvers then run on the assembled batch, so
+  `--stream-train` writes a byte-identical model to the one-shot driver
+  while never holding more than a batch of rows on host.
+
+- **shard cache** (`DeviceShardCache`): each batch becomes a PADDED
+  static-shape `CSRFeatures` block (rows and nnz quantized by the
+  serving `BucketLadder`, so per-bucket jitted accumulate executables in
+  ops/sharded_objective.py stay enumerable) kept in HBM, with row-space
+  columns (labels/offsets/weights) ALWAYS resident and an explicit
+  `hbm_budget_bytes` that spills FEATURE blocks to host column buffers
+  (replay-aware furthest-next-use eviction, not plain LRU — see
+  `DeviceShardCache`). Solver iterations after the first replay cached
+  device blocks instead of re-decoding Avro; spilled blocks re-upload
+  through `HostPrefetcher` + `chunked_device_put` so H2D of shard k+1
+  overlaps the accumulate of shard k (the same three-stage pipeline
+  shape as streamed scoring).
+
+The reference's analog is treeAggregate over cached RDD partitions
+(`ValueAndGradientAggregator.scala:243-274`): no node ever holds the whole
+dataset, partials combine in a fixed deterministic order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.data.device_feed import HostPrefetcher, chunked_device_put
+from photon_ml_tpu.ops.features import (
+    CSRFeatures,
+    DENSE_DENSITY_THRESHOLD,
+    padded_csr_arrays,
+)
+from photon_ml_tpu.serving.buckets import BucketLadder, next_pow2
+
+
+def _row_ids_i32(indptr: np.ndarray, offset: int = 0) -> np.ndarray:
+    n = len(indptr) - 1
+    return (np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+            + offset).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Exact assembly: streamed ingest -> the one-shot device batch, bit for bit
+# ---------------------------------------------------------------------------
+
+
+class StreamedFixedEffectData:
+    """Duck-typed stand-in for the GameDataset a FixedEffectCoordinate
+    consumes: the feature batch is already device-assembled from a
+    stream, so `fixed_effect_batch` hands it back instead of re-uploading
+    host CSR. Exposes exactly the surface the fixed-effect training path
+    touches (`num_rows`, `feature_shards[...].shape`,
+    `responses`/`offsets`/`weights` for the coordinate-descent objective
+    rows, `fixed_effect_batch`)."""
+
+    class _ShapeOnly:
+        def __init__(self, shape):
+            self.shape = shape
+
+    def __init__(self, shard_id: str, batch, n_rows: int, d: int,
+                 ingest_stats: dict):
+        self._shard_id = shard_id
+        self._batch = batch
+        self._n_rows = int(n_rows)
+        self.feature_shards = {shard_id: self._ShapeOnly((n_rows, d))}
+        # Device f32 columns: jnp.asarray(col, dtype) in the consumer is a
+        # no-op cast, value-identical to the one-shot host-f64 -> f32 cast.
+        self.responses = batch.labels
+        self.offsets = batch.offsets
+        self.weights = batch.weights
+        self.ingest_stats = dict(ingest_stats)
+
+    @property
+    def num_rows(self) -> int:
+        return self._n_rows
+
+    def fixed_effect_batch(self, shard_id: str, dtype=None,
+                           extra_offsets=None):
+        from photon_ml_tpu.ops.glm_objective import GLMBatch
+
+        if shard_id != self._shard_id:
+            raise KeyError(
+                f"streamed ingest assembled shard {self._shard_id!r}, "
+                f"coordinate asked for {shard_id!r}")
+        if dtype is not None and np.dtype(dtype) != np.dtype(
+                np.asarray(self._batch.labels).dtype):
+            raise ValueError(
+                f"streamed batch was assembled as "
+                f"{np.asarray(self._batch.labels).dtype}, asked for {dtype}")
+        if extra_offsets is None:
+            return self._batch
+        return GLMBatch(self._batch.features, self._batch.labels,
+                        self._batch.offsets + extra_offsets,
+                        self._batch.weights)
+
+
+def assemble_fixed_effect_batch(
+    stream, shard_id: str, dtype=np.float32,
+    dense_threshold: float = DENSE_DENSITY_THRESHOLD,
+) -> StreamedFixedEffectData:
+    """Consume a BlockGameStream into ONE device GLMBatch, bitwise equal
+    to `read_game_dataset(...)[0].fixed_effect_batch(shard_id, dtype)`.
+
+    Host residency is O(batch_rows): each decoded batch's arrays upload
+    (async) and are dropped before the next batch decodes. Device pieces
+    are exact slices of the one-shot arrays (row-contiguous CSR cuts +
+    the same elementwise f64->f32 / int->i32 casts), so the final
+    device-side concatenation reconstructs the one-shot upload exactly —
+    including the dense-vs-CSR layout decision, which is made from the
+    GLOBAL density after the stream ends, exactly like
+    `features_to_device` on the full matrix."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.glm_objective import GLMBatch
+
+    vals_p, cols_p, rows_p = [], [], []
+    lab_p, off_p, wgt_p = [], [], []
+    n_rows = 0
+    nnz = 0
+    d = None
+    for ds in stream:
+        mat = ds.feature_shards[shard_id].tocsr()
+        d = mat.shape[1]
+        if ds.num_rows == 0:
+            continue
+        # Exact one-shot pieces: csr_from_scipy's COO row-stable sort is
+        # the identity on a canonical CSR, so data/indices ARE the slices.
+        vals_p.append(chunked_device_put(mat.data, dtype))
+        cols_p.append(jnp.asarray(mat.indices.astype(np.int32)))
+        rows_p.append(jnp.asarray(_row_ids_i32(mat.indptr, n_rows)))
+        lab_p.append(chunked_device_put(ds.responses, dtype))
+        off_p.append(chunked_device_put(ds.offsets, dtype))
+        wgt_p.append(chunked_device_put(ds.weights, dtype))
+        n_rows += ds.num_rows
+        nnz += mat.nnz
+    if n_rows == 0:
+        raise ValueError("stream yielded no rows to assemble")
+
+    def cat(parts):
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    values, col_ids, row_ids = cat(vals_p), cat(cols_p), cat(rows_p)
+    feats = CSRFeatures(values, col_ids, row_ids, n_rows, int(d))
+    density = nnz / max(1, n_rows * d)
+    if density >= dense_threshold:
+        # One-shot path densifies before upload; scattering the exact CSR
+        # pieces into zeros reproduces the same array (no duplicates, and
+        # the f64->f32 value cast already happened elementwise at upload).
+        feats = feats.to_dense()
+    batch = GLMBatch(features=feats, labels=cat(lab_p), offsets=cat(off_p),
+                     weights=cat(wgt_p))
+    stats = dict(stream.stats())
+    stats.update({"assembled_rows": n_rows, "assembled_nnz": nnz,
+                  "density": density,
+                  "layout": type(feats).__name__})
+    return StreamedFixedEffectData(shard_id, batch, n_rows, int(d), stats)
+
+
+# ---------------------------------------------------------------------------
+# The shard cache: padded device blocks, replay-aware spill, prefetch
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CachedShard:
+    """One streamed batch as a static-shape device block.
+
+    Row-space columns (labels/offsets/weights, padded to ``rows_bucket``
+    with weight-0 rows) are ALWAYS device-resident — they are the cheap
+    4-bytes-per-row part, and keeping them resident is what makes the
+    margin-cached line search feature-pass-free. The FEATURE triplet
+    (``feats``) is the evictable part; ``host_values/cols/rows`` are the
+    spill buffers it re-uploads from."""
+
+    index: int
+    n_rows: int  # true rows (<= rows_bucket)
+    nnz: int  # true nnz (<= nnz_bucket)
+    rows_bucket: int
+    nnz_bucket: int
+    row_offset: int  # first global row id
+    labels: object  # device f[rows_bucket]
+    offsets: object
+    weights: object
+    host_values: Optional[np.ndarray]  # f32[nnz_bucket] spill buffer
+    host_cols: Optional[np.ndarray]  # i32[nnz_bucket]
+    host_rows: Optional[np.ndarray]  # i32[nnz_bucket] (block-local)
+    feats: Optional[CSRFeatures] = None  # None = spilled
+
+    @property
+    def feature_bytes(self) -> int:
+        # values f32 + col_ids i32 + row_ids i32, at the padded shape.
+        return 12 * self.nnz_bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidentBlock:
+    """A shard handed out by `DeviceShardCache.blocks()`: a SNAPSHOT
+    holding its own strong reference to the device feature triplet, so a
+    later eviction (which only drops the cache's reference) can never
+    pull the arrays out from under an in-flight accumulate."""
+
+    index: int
+    n_rows: int
+    feats: CSRFeatures
+    labels: object
+    offsets: object
+    weights: object
+
+
+class DeviceShardCache:
+    """Device cache of padded feature blocks over a streamed ingest.
+
+    Built once from a `BlockGameStream` (`from_stream`); every solver
+    iteration then replays `blocks()` in FIXED shard order — the
+    accumulation order is part of the numeric contract, so resident,
+    spilled, and re-uploaded replays produce bitwise-identical partials
+    (re-uploaded bytes are the bytes that were evicted).
+
+    ``hbm_budget_bytes`` bounds the feature bytes resident on device;
+    `None` means unbounded (fully resident, spill buffers freed). The
+    budget is enforced DURING ingest (evict-as-you-go, so ingest peak
+    HBM is O(budget), not O(dataset)) and on every re-upload. Eviction
+    is replay-aware rather than plain LRU: the replay order is the fixed
+    shard order, so the victim is the resident block whose next use is
+    FURTHEST in the cyclic order. Plain LRU degenerates to a 0% hit
+    rate here — with n shards and budget n-1, the least-recently-used
+    block is always exactly the next one needed (n misses/epoch). The
+    distance rule pays ~(n - budget_blocks) misses per epoch plus a
+    small wrap-around surcharge (the in-hand block must be cached, so
+    the resident "hole" walks and costs one extra miss every n-1
+    epochs: amortized 1 + 1/(n-1) misses/epoch at budget n-1 with
+    equal blocks) —
+    per-epoch re-uploads stay close to (dataset - budget) bytes instead
+    of the whole dataset. The in-hand block is never evicted; one block
+    can exceed a too-small budget (you cannot accumulate a block that
+    is not there).
+    """
+
+    def __init__(self, entries: List[CachedShard], n_rows: int,
+                 n_features: int, dtype,
+                 hbm_budget_bytes: Optional[int] = None,
+                 prefetch_depth: int = 2,
+                 ingest_stats: Optional[dict] = None):
+        self._entries = entries
+        self.n_rows = int(n_rows)
+        self.n_features = int(n_features)
+        self.dtype = np.dtype(dtype)
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        self.ingest_stats = dict(ingest_stats or {})
+        self._stats = {"hits": 0, "misses": 0, "evictions": 0,
+                       "bytes_reuploaded": 0, "epochs": 0}
+        self.device_bytes = sum(e.feature_bytes for e in entries
+                                if e.feats is not None)
+        self.peak_device_bytes = self.device_bytes
+        if hbm_budget_bytes is None:
+            for e in entries:
+                e.host_values = e.host_cols = e.host_rows = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_stream(cls, stream, shard_id: str, dtype=np.float32,
+                    hbm_budget_bytes: Optional[int] = None,
+                    min_rows_bucket: int = 16,
+                    prefetch_depth: int = 2) -> "DeviceShardCache":
+        """Ingest pass: decode (prefetched, via the stream) -> pad to the
+        bucket ladder -> upload. Decode of batch k+1 overlaps the H2D of
+        batch k (device_put is async; the stream's prefetch thread keeps
+        decoding while uploads ride the wire). With an ``hbm_budget``
+        the budget is enforced AS blocks upload — the most recently
+        ingested block spills first (its next use, at the start of the
+        first replay epoch, is the furthest away), so ingest-peak device
+        bytes stay O(budget + one block) and the resident set ends as a
+        stable PREFIX of the shard order."""
+        import jax.numpy as jnp
+
+        entries: List[CachedShard] = []
+        n_rows = 0
+        d = None
+        ladder = None
+        device_bytes = 0
+        peak_bytes = 0
+        evictions = 0
+        for ds in stream:
+            if ds.num_rows == 0:
+                continue
+            mat = ds.feature_shards[shard_id].tocsr()
+            d = mat.shape[1]
+            if ladder is None:
+                ladder = BucketLadder(
+                    min_rows=min(min_rows_bucket, next_pow2(ds.num_rows)),
+                    max_rows=next_pow2(ds.num_rows))
+            rb = ladder.rows_bucket(ds.num_rows)
+            nb = ladder.nnz_bucket(mat.nnz, rb)
+            values, cols, rows = padded_csr_arrays(
+                mat, rb, nb, value_dtype=dtype)
+
+            def col(x):
+                out = np.zeros(rb, dtype)
+                out[:ds.num_rows] = x
+                return jnp.asarray(out)
+
+            e = CachedShard(
+                index=len(entries), n_rows=ds.num_rows, nnz=int(mat.nnz),
+                rows_bucket=rb, nnz_bucket=nb, row_offset=n_rows,
+                labels=col(ds.responses), offsets=col(ds.offsets),
+                weights=col(ds.weights),
+                host_values=values, host_cols=cols, host_rows=rows,
+                feats=CSRFeatures(
+                    chunked_device_put(values), jnp.asarray(cols),
+                    jnp.asarray(rows), rb, int(d)),
+            )
+            entries.append(e)
+            n_rows += ds.num_rows
+            device_bytes += e.feature_bytes
+            peak_bytes = max(peak_bytes, device_bytes)
+            if hbm_budget_bytes is not None:
+                # Evict-as-you-go: most-recent-first (keep the prefix),
+                # never the block just uploaded.
+                for victim in reversed(entries[:-1]):
+                    if device_bytes <= hbm_budget_bytes:
+                        break
+                    if victim.feats is not None:
+                        victim.feats = None
+                        device_bytes -= victim.feature_bytes
+                        evictions += 1
+        if not entries:
+            raise ValueError("stream yielded no rows to cache")
+        cache = cls(entries, n_rows, int(d), dtype,
+                    hbm_budget_bytes=hbm_budget_bytes,
+                    prefetch_depth=prefetch_depth,
+                    ingest_stats=stream.stats())
+        cache._stats["evictions"] += evictions
+        cache.peak_device_bytes = max(cache.peak_device_bytes, peak_bytes)
+        if hbm_budget_bytes is not None:
+            # The final block stayed pinned during ingest; settle to the
+            # budget with the replay-aware policy (next use = shard 0).
+            cache._enforce_budget(pinned=-1)
+        return cache
+
+    # -- residency management ----------------------------------------------
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._entries)
+
+    @property
+    def entries(self) -> List[CachedShard]:
+        return list(self._entries)
+
+    def bucket_shapes(self) -> set:
+        return {(e.rows_bucket, e.nnz_bucket) for e in self._entries}
+
+    def _enforce_budget(self, pinned: int) -> None:
+        """Evict until within budget. Victim = resident block whose next
+        use is FURTHEST in the fixed cyclic replay order from the block
+        in hand (`pinned`; -1 = before an epoch, i.e. next use starts at
+        shard 0). Belady's rule for a known cyclic scan — see the class
+        docstring for why plain LRU is pathological here."""
+        budget = self.hbm_budget_bytes
+        if budget is None:
+            return
+        n = len(self._entries)
+        cur = pinned if pinned >= 0 else 0
+        resident = [e for e in self._entries
+                    if e.feats is not None and e.index != pinned]
+        # descending cyclic distance (j - cur) mod n: furthest-next-use
+        # first; ties impossible (indexes are unique).
+        resident.sort(key=lambda e: -((e.index - cur) % n))
+        while self.device_bytes > budget and resident:
+            victim = resident.pop(0)
+            victim.feats = None
+            self.device_bytes -= victim.feature_bytes
+            self._stats["evictions"] += 1
+
+    def ensure(self, index: int) -> ResidentBlock:
+        """Return a resident snapshot of the block, re-uploading the
+        spill buffers on a miss (async put — the caller overlaps it with
+        whatever it is accumulating)."""
+        import jax.numpy as jnp
+
+        e = self._entries[index]
+        if e.feats is None:
+            if e.host_values is None:
+                raise RuntimeError(
+                    f"shard {index} was evicted but has no spill buffers "
+                    "(cache built without an hbm budget)")
+            self._stats["misses"] += 1
+            self._stats["bytes_reuploaded"] += e.feature_bytes
+            self.device_bytes += e.feature_bytes
+            self.peak_device_bytes = max(self.peak_device_bytes,
+                                         self.device_bytes)
+            e.feats = CSRFeatures(
+                chunked_device_put(e.host_values),
+                jnp.asarray(e.host_cols), jnp.asarray(e.host_rows),
+                e.rows_bucket, self.n_features)
+            self._enforce_budget(pinned=index)
+        else:
+            self._stats["hits"] += 1
+        return ResidentBlock(index=e.index, n_rows=e.n_rows, feats=e.feats,
+                             labels=e.labels, offsets=e.offsets,
+                             weights=e.weights)
+
+    def blocks(self, prefetch_depth: Optional[int] = None
+               ) -> Iterator[ResidentBlock]:
+        """One replay epoch in fixed shard order. With a prefetch depth
+        > 0 the spill re-uploads run on a background thread
+        (`HostPrefetcher`), so H2D of shard k+1 overlaps the consumer's
+        accumulate of shard k; resident epochs yield straight from HBM."""
+        self._stats["epochs"] += 1
+        depth = (self.prefetch_depth if prefetch_depth is None
+                 else max(0, int(prefetch_depth)))
+
+        def gen():
+            for i in range(len(self._entries)):
+                yield self.ensure(i)
+
+        if depth < 1 or self.hbm_budget_bytes is None:
+            yield from gen()
+            return
+        yield from HostPrefetcher(gen(), depth)
+
+    def stats(self) -> Dict:
+        s = dict(self._stats)
+        s.update({
+            "shards": self.n_shards,
+            "rows": self.n_rows,
+            "bucket_shapes": sorted(self.bucket_shapes()),
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "device_bytes": self.device_bytes,
+            "peak_device_bytes": self.peak_device_bytes,
+            "resident_shards": sum(1 for e in self._entries
+                                   if e.feats is not None),
+        })
+        return s

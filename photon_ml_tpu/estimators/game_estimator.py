@@ -202,14 +202,28 @@ class GameEstimator:
         """Best combo by the first validation evaluator (falling back to the
         training objective when no validation ran) — reference:
         cli/game/training/Driver.selectBestModel (:168-198)."""
-        if not results:
-            raise ValueError("no results")
-        if self.validation_evaluators and results[0][1].validation_history:
-            head = self.validation_evaluators[0]
-            best = None
-            for item in results:
-                metric = item[1].validation_history[-1][head.name]
-                if best is None or head.better_than(metric, best[0]):
-                    best = (metric, item)
-            return best[1]
-        return min(results, key=lambda item: item[1].objective_history[-1])
+        return select_best_result(results, self.validation_evaluators)
+
+
+def select_best_result(
+    results, validation_evaluators
+) -> Tuple[Dict[str, GLMOptimizationConfiguration],
+           CoordinateDescentResult]:
+    """THE model-selection rule, shared by GameEstimator.select_best and
+    the --stream-train driver path (one copy, so streamed and one-shot
+    grid selection cannot diverge): best by the first validation
+    evaluator when validation produced metrics, else lowest final
+    training objective. An empty final metrics dict (e.g. an empty
+    streamed validation input) degrades to objective selection."""
+    if not results:
+        raise ValueError("no results")
+    if validation_evaluators and results[0][1].validation_history \
+            and results[0][1].validation_history[-1]:
+        head = validation_evaluators[0]
+        best = None
+        for item in results:
+            metric = item[1].validation_history[-1][head.name]
+            if best is None or head.better_than(metric, best[0]):
+                best = (metric, item)
+        return best[1]
+    return min(results, key=lambda item: item[1].objective_history[-1])

@@ -1,0 +1,271 @@
+"""Sharded GLM objective: full-batch (value, gradient, Hessian-vector)
+by accumulating per-shard partials over a device shard cache.
+
+The TPU out-of-core analog of the reference's treeAggregate objective
+evaluation (`ValueAndGradientAggregator.scala:243-274`,
+`HessianVectorAggregator.scala`): no single array ever spans the dataset —
+each `CachedShard` (data/shard_cache.py) contributes a partial through a
+per-bucket jitted accumulate kernel, and partials fold on device in FIXED
+shard order, so only the final scalar/vector leaves the device.
+
+Numeric contract (measured, not assumed — docs/SCALE.md §Training memory
+envelope): XLA's full-shape reductions are vectorized with
+shape-dependent association, so a sharded accumulation is NOT bitwise
+equal to the one-shot `GLMObjective` in general. What IS guaranteed, and
+tested:
+
+- per-row quantities (margins, loss terms, curvature) are bitwise equal
+  to the one-shot path — they are row-local;
+- a SINGLE unpadded shard reproduces the one-shot
+  `value_from_margins`/`gradient_from_margins` bit for bit (same arrays,
+  same ops);
+- for any fixed shard decomposition, the accumulation is deterministic
+  and INDEPENDENT of cache residency: resident replay, spill/re-upload
+  replay, and prefetch depth all produce identical bits (re-uploaded
+  buffers are the evicted bytes; the fold order is the shard order).
+
+Compile discipline: every kernel is built once per objective instance and
+registered with a `TracingGuard`; each kernel traces once per distinct
+bucket shape, so total compiles <= kernel_families x bucket_shapes —
+assertable, not hand-counted (`assert_trace_budget`).
+
+Normalization is supported by accumulating the RAW `X^T u` partials plus
+`sum(u)` and applying the factor/shift chain ONCE at the apex (the same
+algebra `GLMObjective._jt_product` applies per batch; for a single shard
+the two are bit-identical).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
+from photon_ml_tpu.utils.tracing_guard import TracingGuard
+
+Array = jax.Array
+
+#: Distinct jitted accumulate-kernel families an instance may build; each
+#: traces at most once per bucket shape (see assert_trace_budget).
+KERNEL_FAMILIES = 7
+
+
+class ShardedGLMObjective:
+    """Streaming (value, gradient, Hvp) over a DeviceShardCache.
+
+    ``objective`` supplies the loss and (optional) normalization context;
+    row-space solver state (margins, direction margins, curvature) lives
+    as per-shard lists aligned with the cache's fixed shard order and is
+    always device-resident — the feature blocks are the only thing the
+    cache may spill, which keeps the margin-cached L-BFGS line search
+    feature-pass-free (optimization/glm_lbfgs.py).
+    """
+
+    def __init__(self, objective: GLMObjective, cache,
+                 tracing_guard: Optional[TracingGuard] = None):
+        self.objective = objective
+        self.cache = cache
+        self.guard = tracing_guard if tracing_guard is not None \
+            else TracingGuard()
+        obj = objective
+
+        # Kernels are built per INSTANCE (closures over the stable
+        # objective) so each instance's guard owns its trace counts; one
+        # kernel traces once per distinct (rows_bucket, nnz_bucket).
+
+        # Row-space REDUCTIONS slice to the shard's true row count ``n``
+        # (a STATIC arg) before summing: XLA's vectorized reduce is not
+        # prefix-stable under zero-padding (tail-lane association depends
+        # on the reduced length), so summing wl[:n] — the same shape the
+        # one-shot path reduces — is what makes the single-shard partial
+        # bitwise-exact. A stream yields at most two distinct true row
+        # counts (batch_rows + the final partial), so the extra static
+        # arg at most doubles each family's compile count. The rmatvec
+        # scatter stays at the PADDED shape (pad entries contribute +0 to
+        # row 0/col 0; prefix stability is pinned by the bitwise tests).
+
+        def init_kernel(feats, labels, offsets, weights, coef, n: int):
+            """Margins + value partial + raw-gradient partial, one pass."""
+            batch = GLMBatch(feats, labels, offsets, weights)
+            z = obj.margins(coef, batch)
+            val = jnp.sum((weights * obj.loss.loss(z, labels))[:n])
+            u = weights * obj.loss.d1(z, labels)
+            return z, val, feats.rmatvec(u), jnp.sum(u[:n])
+
+        def direction_kernel(feats, labels, offsets, weights, direction):
+            """Directional margins: exactly objective.margin_direction."""
+            batch = GLMBatch(feats, labels, offsets, weights)
+            return obj.margin_direction(direction, batch)
+
+        def trial_kernel(z, zp, labels, weights, ts, n: int):
+            """[K] weighted-loss sums at z + t*zp — the batched Armijo
+            sweep's data terms, reduced at the one-shot [K, n] shape."""
+            z_t = z[None, :n] + ts[:, None] * zp[None, :n]
+            return jnp.sum(
+                weights[None, :n] * obj.loss.loss(z_t, labels[None, :n]),
+                axis=-1)
+
+        def grad_kernel(feats, labels, weights, z, n: int):
+            u = weights * obj.loss.d1(z, labels)
+            return feats.rmatvec(u), jnp.sum(u[:n])
+
+        def curvature_kernel(z, labels, weights):
+            return weights * obj.loss.d2(z, labels)
+
+        def hvp_kernel(feats, labels, offsets, weights, d2, vec, n: int):
+            batch = GLMBatch(feats, labels, offsets, weights)
+            jv = obj.margin_direction(vec, batch)
+            t = d2 * jv
+            return feats.rmatvec(t), jnp.sum(t[:n])
+
+        def acc_kernel(acc, part):
+            return jax.tree.map(jnp.add, acc, part)
+
+        self._k_init = jax.jit(init_kernel, static_argnames=("n",))
+        self._k_dir = jax.jit(direction_kernel)
+        self._k_trial = jax.jit(trial_kernel, static_argnames=("n",))
+        self._k_grad = jax.jit(grad_kernel, static_argnames=("n",))
+        self._k_curv = jax.jit(curvature_kernel)
+        self._k_hvp = jax.jit(hvp_kernel, static_argnames=("n",))
+        self._k_acc = jax.jit(acc_kernel)
+        for name, fn in [("init", self._k_init), ("dir", self._k_dir),
+                         ("trial", self._k_trial), ("grad", self._k_grad),
+                         ("curv", self._k_curv), ("hvp", self._k_hvp),
+                         ("acc", self._k_acc)]:
+            self.guard.track(f"sharded:{name}", fn)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return self.cache.n_rows
+
+    @property
+    def dim(self) -> int:
+        return self.cache.n_features
+
+    def trace_budgets(self) -> dict:
+        """Per-kernel compile budgets in terms of the cache's bucket
+        count: feature kernels trace once per (rows, nnz) bucket shape;
+        the trial kernel additionally distinguishes the [K]-candidate
+        block from the [1]-candidate sequential tail; the tree
+        accumulator traces once per partial STRUCTURE (value-grad
+        triple, trial vector, hvp pair), independent of buckets."""
+        buckets = max(1, len(self.cache.bucket_shapes()))
+        row_buckets = max(1, len({b[0] for b in
+                                  self.cache.bucket_shapes()}))
+        return {
+            "sharded:init": 2 * buckets,
+            "sharded:dir": buckets,
+            "sharded:grad": 2 * buckets,
+            "sharded:hvp": 2 * buckets,
+            "sharded:trial": 4 * row_buckets,
+            "sharded:curv": row_buckets,
+            "sharded:acc": 4,
+        }
+
+    def assert_trace_budget(self) -> None:
+        """Compile-count invariant, asserted via the TracingGuard rather
+        than hand-counted: each kernel family stays within
+        trace_budgets() (total <= KERNEL_FAMILIES x buckets + O(1))."""
+        from photon_ml_tpu.utils.tracing_guard import RetraceError
+
+        budgets = self.trace_budgets()
+        counts = self.guard.counts()
+        over = {k: (v, budgets[k]) for k, v in counts.items()
+                if k in budgets and v > budgets[k]}
+        if over:
+            raise RetraceError(
+                f"sharded-objective kernels exceeded their per-bucket "
+                f"trace budgets: {over} (bucket shapes: "
+                f"{sorted(self.cache.bucket_shapes())})")
+
+    # -- accumulation passes ----------------------------------------------
+
+    def _fold(self, acc, part):
+        """Left-fold in shard order — the deterministic combine."""
+        return part if acc is None else self._k_acc(acc, part)
+
+    def _finish_grad(self, g_raw: Array, su: Array, coef: Array,
+                     l2) -> Array:
+        """Apply the normalization chain + L2 ONCE at the apex (same
+        algebra as GLMObjective._jt_product + l2*coef)."""
+        norm = self.objective.normalization
+        r = g_raw
+        if norm is not None:
+            if norm.shifts is not None:
+                r = r - su * norm.shifts
+            if norm.factors is not None:
+                r = r * norm.factors
+        return r + l2 * coef
+
+    def margins_value_grad(self, coef: Array, l2
+                           ) -> Tuple[List[Array], Array, Array]:
+        """One pass over the feature blocks: per-shard margins (kept as
+        device row-space state), the objective value, and the gradient."""
+        z_list: List[Array] = []
+        acc = None
+        for e in self.cache.blocks():
+            z, val, g_raw, su = self._k_init(
+                e.feats, e.labels, e.offsets, e.weights, coef,
+                n=e.n_rows)
+            z_list.append(z)
+            acc = self._fold(acc, (val, g_raw, su))
+        val, g_raw, su = acc
+        f = val + 0.5 * l2 * jnp.vdot(coef, coef)
+        return z_list, f, self._finish_grad(g_raw, su, coef, l2)
+
+    def value_and_grad(self, coef: Array, l2=0.0) -> Tuple[Array, Array]:
+        _, f, g = self.margins_value_grad(coef, jnp.asarray(l2))
+        return f, g
+
+    def margin_direction_list(self, direction: Array) -> List[Array]:
+        """Per-shard directional margins (one feature pass)."""
+        return [self._k_dir(e.feats, e.labels, e.offsets, e.weights,
+                            direction)
+                for e in self.cache.blocks()]
+
+    def trial_values(self, z_list: Sequence[Array],
+                     zp_list: Sequence[Array], ts: Array,
+                     coef_sq: Array, l2) -> Array:
+        """Objective values at the [K] line-search candidates — row-space
+        only (margins are cached), NO feature pass, no spill traffic."""
+        acc = None
+        for e, z, zp in zip(self.cache.entries, z_list, zp_list):
+            part = self._k_trial(z, zp, e.labels, e.weights, ts,
+                                 n=e.n_rows)
+            acc = self._fold(acc, part)
+        return acc + 0.5 * l2 * coef_sq
+
+    def grad_from_margins_list(self, coef: Array,
+                               z_list: Sequence[Array], l2) -> Array:
+        """Gradient given cached margins: one rmatvec pass."""
+        acc = None
+        blocks = self.cache.blocks()
+        for e, z in zip(blocks, z_list):
+            acc = self._fold(acc, self._k_grad(e.feats, e.labels,
+                                               e.weights, z, n=e.n_rows))
+        g_raw, su = acc
+        return self._finish_grad(g_raw, su, coef, l2)
+
+    def curvature_list(self, z_list: Sequence[Array]) -> List[Array]:
+        """d2_i = w_i l''(z_i, y_i) per shard — computed once per TRON
+        outer iteration, row-space resident for the inner CG."""
+        return [self._k_curv(z, e.labels, e.weights)
+                for e, z in zip(self.cache.entries, z_list)]
+
+    def hessian_vector(self, vec: Array, d2_list: Sequence[Array],
+                       l2) -> Array:
+        """H @ vec with precomputed curvature: one matvec + one rmatvec
+        per shard (the streaming form of
+        GLMObjective.hessian_vector_from_margins)."""
+        acc = None
+        blocks = self.cache.blocks()
+        for e, d2 in zip(blocks, d2_list):
+            acc = self._fold(acc, self._k_hvp(
+                e.feats, e.labels, e.offsets, e.weights, d2, vec,
+                n=e.n_rows))
+        r_raw, su = acc
+        return self._finish_grad(r_raw, su, vec, l2)

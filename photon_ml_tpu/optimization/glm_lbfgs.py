@@ -223,6 +223,182 @@ def _minimize_lbfgs_glm_impl(
     )
 
 
+@jax.jit
+def _stream_direction(g, hist, x):
+    """Search direction + the line-search dot products ([d]-space only),
+    mirroring the fused body's first block bit for bit."""
+    direction = compact_direction(g, hist)
+    dg = jnp.vdot(direction, g)
+    direction = jnp.where(dg >= 0, -g, direction)
+    return (direction, jnp.vdot(x, x), jnp.vdot(x, direction),
+            jnp.vdot(direction, direction), jnp.vdot(g, direction))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _stream_candidates(first, pp, f, gp, n, c1):
+    """The batched Armijo candidate block t_k = init * shrink^k and the
+    acceptance thresholds — same expressions as the fused impl."""
+    dtype = pp.dtype
+    init_step = jnp.where(first, 1.0 / jnp.maximum(jnp.sqrt(pp), 1.0),
+                          jnp.ones((), dtype))
+    ks = jnp.arange(n, dtype=dtype)
+    ts = init_step * jnp.power(jnp.asarray(0.5, dtype), ks)
+    return ts, f + c1 * ts * gp
+
+
+@jax.jit
+def _stream_coef_sq(xx, xp, pp, ts):
+    return xx + 2.0 * ts * xp + ts * ts * pp
+
+
+@jax.jit
+def _stream_axpy(a, t, b):
+    return a + t * b
+
+
+@jax.jit
+def _stream_update_history(hist, x_new, x, g_new, g):
+    return update_history(hist, x_new - x, g_new - g)
+
+
+def minimize_lbfgs_glm_streaming(
+    sharded_objective,
+    x0: Array,
+    l2_weight,
+    *,
+    max_iter: int = 100,
+    tol: float = 1e-7,
+    history_size: int = 10,
+    c1: float = 1e-4,
+    max_line_search: int = 30,
+    track_coefficients: bool = False,
+) -> OptimizerResult:
+    """Out-of-core L-BFGS: the outer iteration runs on the host, streaming
+    each feature pass through a :class:`ShardedGLMObjective`
+    (ops/sharded_objective.py) whose shard cache replays device-resident
+    blocks (spilling/re-uploading under an HBM budget).
+
+    Semantics mirror `_minimize_lbfgs_glm_impl` step for step — margins
+    cached per shard (row-space, always resident), ONE matvec pass for
+    the whole line search, one rmatvec pass for the accepted gradient,
+    identical convergence reasons — so per-iteration feature passes stay
+    at exactly 2. The accumulation order is the fixed shard order, so
+    results are deterministic and independent of cache residency (see
+    the numeric contract in ops/sharded_objective.py; a single-shard
+    cache reproduces the fused path bit for bit).
+    """
+    import numpy as np
+
+    sobj = sharded_objective
+    x = jnp.asarray(x0)
+    dtype = x.dtype
+    np_dtype = np.dtype(dtype)
+    l2 = jnp.asarray(l2_weight, dtype)
+    d = x.shape[-1]
+    shrink = jnp.asarray(0.5, dtype)
+    n_batched = min(max_line_search + 1, 8)
+
+    def host(v):
+        # 0-d numpy scalar in the solve dtype: host-side convergence
+        # arithmetic stays in the SAME precision as the fused impl's
+        # on-device comparisons (a python-float compare would widen to
+        # f64 and could flip a boundary decision).
+        return np.asarray(v)[()]
+
+    tol_s = np_dtype.type(tol)
+    z_list, f, g = sobj.margins_value_grad(x, l2)
+    f_h = host(f)
+    gnorm = host(jnp.linalg.norm(g))
+    gnorm0 = gnorm
+    f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
+    hist = _empty_history(d, history_size, dtype)
+
+    value_hist = np.full(max_iter + 1, np.nan, np_dtype)
+    gnorm_hist = np.full(max_iter + 1, np.nan, np_dtype)
+    value_hist[0], gnorm_hist[0] = f_h, gnorm
+    coef_hist = (np.full((max_iter + 1, d), np.nan, np_dtype)
+                 if track_coefficients else None)
+    if coef_hist is not None:
+        coef_hist[0] = np.asarray(x)
+
+    reason = (ConvergenceReason.GRADIENT_CONVERGED if gnorm0 <= 0.0
+              else ConvergenceReason.NOT_CONVERGED)
+    it = 0
+    while reason == ConvergenceReason.NOT_CONVERGED:
+        direction, xx, xp, pp, gp = _stream_direction(g, hist, x)
+        zp_list = sobj.margin_direction_list(direction)
+
+        first = int(hist.count) == 0  # mirrors st.hist.count == 0
+        ts, thresholds = _stream_candidates(
+            jnp.asarray(first), pp, f, gp, n_batched,
+            jnp.asarray(c1, dtype))
+        f_trials = sobj.trial_values(z_list, zp_list, ts,
+                                     _stream_coef_sq(xx, xp, pp, ts), l2)
+        ft_host = np.asarray(f_trials)
+        armijo = np.logical_and(ft_host <= np.asarray(thresholds),
+                                np.isfinite(ft_host))
+        ok = bool(armijo.any())
+        idx = int(np.argmax(armijo))  # first True
+        t_acc = ts[idx]
+        f_new = f_trials[idx]
+
+        k = n_batched
+        t_tail = ts[-1]
+        while not ok and k < max_line_search + 1:
+            # Sequential tail past the batched block — rare (shrink^8).
+            t_tail = t_tail * shrink
+            f_t = sobj.trial_values(
+                z_list, zp_list, t_tail[None],
+                _stream_coef_sq(xx, xp, pp, t_tail[None]), l2)[0]
+            f_t_h = host(f_t)
+            thr = host(f + jnp.asarray(c1, dtype) * t_tail * gp)
+            if f_t_h <= thr and np.isfinite(f_t_h):
+                ok, t_acc, f_new = True, t_tail, f_t
+                break
+            k += 1
+
+        it += 1  # the fused impl counts failed-line-search steps too
+        if not ok:
+            reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+            if it <= max_iter:
+                value_hist[it], gnorm_hist[it] = f_h, gnorm
+                if coef_hist is not None:
+                    coef_hist[it] = np.asarray(x)
+            break
+
+        x_new = _stream_axpy(x, t_acc, direction)
+        z_new = [_stream_axpy(z, t_acc, zp)
+                 for z, zp in zip(z_list, zp_list)]
+        g_new = sobj.grad_from_margins_list(x_new, z_new, l2)
+        hist = _stream_update_history(hist, x_new, x, g_new, g)
+
+        gnorm_new = host(jnp.linalg.norm(g_new))
+        f_new_h = host(f_new)
+        f_delta = np.abs(f_h - f_new_h)
+        x, z_list, f, g = x_new, z_new, f_new, g_new
+        f_h, gnorm = f_new_h, gnorm_new
+        value_hist[it], gnorm_hist[it] = f_h, gnorm
+        if coef_hist is not None:
+            coef_hist[it] = np.asarray(x)
+
+        if gnorm_new <= tol_s * gnorm0:
+            reason = ConvergenceReason.GRADIENT_CONVERGED
+        elif f_delta <= tol_s * f0_scale:
+            reason = ConvergenceReason.FUNCTION_VALUES_CONVERGED
+        elif it >= max_iter:
+            reason = ConvergenceReason.MAX_ITERATIONS
+
+    return OptimizerResult(
+        x=x, value=f, grad_norm=jnp.asarray(gnorm, dtype),
+        iterations=jnp.asarray(it, jnp.int32),
+        reason=jnp.asarray(int(reason), jnp.int32),
+        value_history=jnp.asarray(value_hist),
+        grad_norm_history=jnp.asarray(gnorm_hist),
+        coef_history=(None if coef_hist is None
+                      else jnp.asarray(coef_hist)),
+    )
+
+
 def minimize_lbfgs_glm(
     objective: GLMObjective,
     batch: GLMBatch,

@@ -279,6 +279,179 @@ def _minimize_tron_impl(
     )
 
 
+@jax.jit
+def _stream_cg_step(s, r, d_vec, rtr, hd, delta, stop_norm):
+    """One Steihaug-Toint CG step given the (streamed) Hessian product —
+    the body of `_truncated_cg` verbatim, as a single [d]-space dispatch;
+    the streaming driver makes the loop decisions on host."""
+    dtype = s.dtype
+    dhd = jnp.vdot(d_vec, hd)
+    alpha = rtr / jnp.where(dhd > 0, dhd, jnp.asarray(1.0, dtype))
+    s_try = s + alpha * d_vec
+    crossed = jnp.logical_or(jnp.linalg.norm(s_try) > delta, dhd <= 0)
+
+    std = jnp.vdot(s, d_vec)
+    dd = jnp.vdot(d_vec, d_vec)
+    ss = jnp.vdot(s, s)
+    gap = jnp.maximum(delta * delta - ss, 0.0)
+    rad = jnp.sqrt(jnp.maximum(std * std + dd * gap, 0.0))
+    safe_dd = jnp.maximum(dd, 1e-30)
+    tau = jnp.where(std >= 0, gap / jnp.maximum(std + rad, 1e-30),
+                    (rad - std) / safe_dd)
+
+    step = jnp.where(crossed, tau, alpha)
+    s_new = s + step * d_vec
+    r_new = r - step * hd
+    rtr_new = jnp.vdot(r_new, r_new)
+    beta = rtr_new / jnp.maximum(rtr, 1e-30)
+    d_new = r_new + beta * d_vec
+    done = jnp.logical_or(crossed, jnp.sqrt(rtr_new) <= stop_norm)
+    return s_new, r_new, d_new, rtr_new, done
+
+
+@jax.jit
+def _stream_tr_update(f, f_new, g, s, r, delta, first):
+    """Trust-region bookkeeping for one outer step — the LIBLINEAR radius
+    interpolation of `_minimize_tron_impl` (unbounded branch), verbatim."""
+    gs = jnp.vdot(g, s)
+    prered = -0.5 * (gs - jnp.vdot(s, r))
+    actred = f - f_new
+    snorm = jnp.linalg.norm(s)
+    delta = jnp.where(first, jnp.minimum(delta, snorm), delta)
+
+    denom = f_new - f - gs
+    alpha = jnp.where(
+        denom <= 0, _SIGMA3,
+        jnp.maximum(_SIGMA1, -0.5 * (gs / jnp.maximum(denom, 1e-30))))
+    alpha_s = alpha * snorm
+    delta = jnp.where(
+        actred < _ETA0 * prered,
+        jnp.minimum(jnp.maximum(alpha, _SIGMA1) * snorm, _SIGMA2 * delta),
+        jnp.where(
+            actred < _ETA1 * prered,
+            jnp.maximum(_SIGMA1 * delta,
+                        jnp.minimum(alpha_s, _SIGMA2 * delta)),
+            jnp.where(
+                actred < _ETA2 * prered,
+                jnp.maximum(_SIGMA1 * delta,
+                            jnp.minimum(alpha_s, _SIGMA3 * delta)),
+                jnp.maximum(delta, jnp.minimum(alpha_s, _SIGMA3 * delta)),
+            ),
+        ),
+    )
+    accept = jnp.logical_and(actred > _ETA0 * prered, jnp.isfinite(f_new))
+    return delta, accept
+
+
+def minimize_tron_streaming(
+    sharded_objective,
+    x0: Array,
+    l2_weight,
+    *,
+    max_iter: int = 15,
+    tol: float = 1e-5,
+    max_cg: int = 20,
+    max_improvement_failures: int = 5,
+    track_coefficients: bool = False,
+) -> OptimizerResult:
+    """Out-of-core TRON: the outer trust-region loop runs on the host;
+    each value/gradient evaluation and each inner-CG Hessian-vector
+    product is a streaming pass over the shard cache
+    (ops/sharded_objective.py — margins + curvature computed once per
+    outer iteration, exactly like `GLMObjective.make_tron_hvp`; each CG
+    product costs one matvec + one rmatvec per shard). Unsupported here:
+    box constraints (use the resident path). Accumulation order is the
+    fixed shard order — deterministic, residency-independent."""
+    import numpy as np
+
+    sobj = sharded_objective
+    x = jnp.asarray(x0)
+    dtype = x.dtype
+    np_dtype = np.dtype(dtype)
+    l2 = jnp.asarray(l2_weight, dtype)
+
+    def host(v):
+        return np.asarray(v)[()]
+
+    tol_s = np_dtype.type(tol)
+    z_list, f, g = sobj.margins_value_grad(x, l2)
+    f_h = host(f)
+    gnorm = host(jnp.linalg.norm(g))
+    gnorm0 = gnorm
+    f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
+    delta = jnp.asarray(gnorm0, dtype)
+
+    value_hist = np.full(max_iter + 1, np.nan, np_dtype)
+    gnorm_hist = np.full(max_iter + 1, np.nan, np_dtype)
+    value_hist[0], gnorm_hist[0] = f_h, gnorm
+    coef_hist = (np.full((max_iter + 1, x.shape[-1]), np.nan, np_dtype)
+                 if track_coefficients else None)
+    if coef_hist is not None:
+        coef_hist[0] = np.asarray(x)
+
+    reason = (ConvergenceReason.GRADIENT_CONVERGED if gnorm0 <= 0.0
+              else ConvergenceReason.NOT_CONVERGED)
+    it = 0
+    fails = 0
+    first = True
+    while reason == ConvergenceReason.NOT_CONVERGED:
+        d2_list = sobj.curvature_list(z_list)
+
+        # -- truncated CG (streamed Hv per step) --------------------------
+        s = jnp.zeros_like(g)
+        r = -g
+        d_vec = -g
+        rtr = jnp.vdot(r, r)
+        stop_norm = _CG_XI * jnp.linalg.norm(g)
+        cg_done = bool(host(jnp.linalg.norm(r) <= stop_norm))
+        k = 0
+        while not cg_done and k < max_cg:
+            hd = sobj.hessian_vector(d_vec, d2_list, l2)
+            s, r, d_vec, rtr, done_dev = _stream_cg_step(
+                s, r, d_vec, rtr, hd, delta, stop_norm)
+            cg_done = bool(host(done_dev))
+            k += 1
+
+        x_try = x + s
+        z_try, f_new, g_new = sobj.margins_value_grad(x_try, l2)
+        delta, accept_dev = _stream_tr_update(
+            f, f_new, g, s, r, delta, jnp.asarray(first))
+        first = False
+        accept = bool(host(accept_dev))
+
+        if accept:
+            it += 1
+            fails = 0
+            x, z_list, g = x_try, z_try, g_new
+            f_new_h = host(f_new)
+            f_delta = np.abs(f_h - f_new_h)
+            f, f_h = f_new, f_new_h
+            gnorm = host(jnp.linalg.norm(g))
+            value_hist[it], gnorm_hist[it] = f_h, gnorm
+            if coef_hist is not None:
+                coef_hist[it] = np.asarray(x)
+            if gnorm <= tol_s * gnorm0:
+                reason = ConvergenceReason.GRADIENT_CONVERGED
+            elif f_delta <= tol_s * f0_scale:
+                reason = ConvergenceReason.FUNCTION_VALUES_CONVERGED
+            elif it >= max_iter:
+                reason = ConvergenceReason.MAX_ITERATIONS
+        else:
+            fails += 1
+            if fails > max_improvement_failures:
+                reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+
+    return OptimizerResult(
+        x=x, value=f, grad_norm=jnp.asarray(gnorm, dtype),
+        iterations=jnp.asarray(it, jnp.int32),
+        reason=jnp.asarray(int(reason), jnp.int32),
+        value_history=jnp.asarray(value_hist),
+        grad_norm_history=jnp.asarray(gnorm_hist),
+        coef_history=(None if coef_hist is None
+                      else jnp.asarray(coef_hist)),
+    )
+
+
 def minimize_tron(
     fun: Callable[..., Array],
     x0: Array,

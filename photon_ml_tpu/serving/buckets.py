@@ -16,8 +16,14 @@ import dataclasses
 from typing import Tuple
 
 
-def _next_pow2(n: int) -> int:
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1). Shared by the serving
+    ladder below and the training shard cache (data/shard_cache.py),
+    which sizes its row-bucket ladder from --batch-rows."""
     return 1 << max(0, int(n - 1).bit_length())
+
+
+_next_pow2 = next_pow2
 
 
 @dataclasses.dataclass(frozen=True)

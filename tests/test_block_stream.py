@@ -136,6 +136,38 @@ def test_batches_concatenate_to_one_shot_dataset(stream_file, shard_maps):
             whole.id_columns["userId"].codes])
 
 
+@pytest.mark.native_decoder
+def test_one_shot_read_uses_block_path_and_is_identical(
+        stream_file, shard_maps, monkeypatch):
+    """Single-process `read_game_dataset` now assembles through the C
+    BLOCK decoder (`read_game_dataset_via_blocks` — one decode
+    implementation for one-shot AND streamed reads) and the result must
+    be byte-identical to the pure-python record loop."""
+    from photon_ml_tpu.data.block_stream import read_game_dataset_via_blocks
+
+    via_blocks = read_game_dataset_via_blocks(
+        stream_file, ["userId", "itemId"], shard_maps)
+    assert via_blocks is not None
+    whole, _ = read_game_dataset(stream_file, id_types=["userId", "itemId"],
+                                 feature_shard_maps=shard_maps,
+                                 ingest_workers=1)
+    _assert_batches_identical(via_blocks, whole)
+    _force_no_native(monkeypatch)
+    python_read, _ = read_game_dataset(
+        stream_file, id_types=["userId", "itemId"],
+        feature_shard_maps=shard_maps, ingest_workers=1)
+    _assert_batches_identical(via_blocks, python_read)
+
+
+def test_one_shot_block_read_declines_cleanly(stream_file, shard_maps,
+                                              monkeypatch):
+    from photon_ml_tpu.data.block_stream import read_game_dataset_via_blocks
+
+    _force_no_native(monkeypatch)
+    assert read_game_dataset_via_blocks(
+        stream_file, ["userId"], shard_maps) is None
+
+
 def test_auto_falls_back_without_native(stream_file, shard_maps,
                                         monkeypatch):
     native_first = list(BlockGameStream(stream_file, ["userId"], shard_maps,

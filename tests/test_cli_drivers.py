@@ -470,6 +470,200 @@ def test_glm_driver_profile_trace(tmp_path, rng):
     assert any(prof.rglob("*.xplane.pb")), list(prof.rglob("*"))
 
 
+def _write_sparse_fe_avro(path, rng, n=240, d=40, per_row=4, offset=0):
+    """Fixed-effect-only TrainingExampleAvro with density below the dense
+    threshold, so ingest takes the CSR layout (the --stream-train sparse
+    assembly path)."""
+    w = rng.normal(0, 1, d + 1)
+    records = []
+    for i in range(n):
+        idx = rng.choice(d, size=per_row, replace=False)
+        vals = rng.normal(0, 1, per_row)
+        z = float(vals @ w[idx] + w[-1])
+        records.append({
+            "uid": f"u{offset + i}",
+            "label": float(rng.random() < 1 / (1 + np.exp(-z))),
+            "features": [{"name": f"f{j}", "term": None, "value": float(v)}
+                         for j, v in zip(idx, vals)],
+            "weight": None, "offset": None, "metadataMap": None})
+    path.mkdir(parents=True, exist_ok=True)
+    write_container(path / "part-00000.avro", schemas.TRAINING_EXAMPLE,
+                    records)
+
+
+_STREAM_BASE = [
+    "--task-type", "LOGISTIC_REGRESSION",
+    "--fixed-effect-data-configurations", "fixed:global",
+    "--fixed-effect-optimization-configurations",
+    "fixed:25,1e-7,1.0,1.0,LBFGS,L2",
+    "--updating-sequence", "fixed",
+]
+
+
+def _coeff_records(out_dir):
+    """Decoded coefficient records — the byte-identity comparison unit
+    (the Avro container header embeds a random sync marker, so FILE bytes
+    can never match; the records carry the exact f32 coefficient bits)."""
+    return list(read_container(
+        out_dir / "best" / "fixed-effect" / "fixed" / "coefficients"
+        / "part-00000.avro"))
+
+
+def test_stream_train_resident_model_identical_to_one_shot(tmp_path, rng):
+    """--stream-train without --hbm-budget assembles the exact one-shot
+    device batch from the streamed ingest: the saved fixed-effect model
+    is identical to the one-shot driver's, bit for bit, for BOTH feature
+    layouts and for non-block-aligned --batch-rows."""
+    for tag, writer in (("sparse", _write_sparse_fe_avro),
+                        ("dense", _write_glm_avro)):
+        train = tmp_path / tag / "train"
+        writer(train, rng, n=220)
+        base = ["--train-input-dirs", str(train)] + _STREAM_BASE
+        one = tmp_path / tag / "one"
+        st = tmp_path / tag / "stream"
+        game_training_driver.run(base + ["--output-dir", str(one)])
+        summary = game_training_driver.run(
+            base + ["--output-dir", str(st), "--stream-train",
+                    "--batch-rows", "33"])
+        assert _coeff_records(one) == _coeff_records(st), tag
+        info = summary["streamTrain"]
+        assert info["mode"] == "resident-assembled"
+        assert info["feeder"]["rows"] == 220
+        assert info["feeder"]["batches"] == 7  # ceil(220/33)
+
+
+def test_stream_train_spill_identical_across_residency(tmp_path, rng):
+    """--hbm-budget mode: eviction-forced, python-feeder, zero-prefetch
+    runs all write the SAME model bytes as a fully-resident streamed run
+    (fixed shard order defines the accumulation); and the result matches
+    the one-shot model to f32 accumulation tolerance."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE
+    one = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "one")])
+    big = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "big"), "--stream-train",
+                "--batch-rows", "64", "--hbm-budget", "64M"])
+    small = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "small"), "--stream-train",
+                "--batch-rows", "64", "--hbm-budget", "8K",
+                "--feeder", "python", "--prefetch-batches", "0"])
+    assert big["streamTrain"]["cache"]["evictions"] == 0
+    assert small["streamTrain"]["cache"]["evictions"] > 0
+    assert _coeff_records(tmp_path / "big") == \
+        _coeff_records(tmp_path / "small")
+    ref = {r["name"]: r["value"]
+           for r in _coeff_records(tmp_path / "one")[0]["means"]}
+    got = {r["name"]: r["value"]
+           for r in _coeff_records(tmp_path / "big")[0]["means"]}
+    assert set(ref) == set(got)
+    np.testing.assert_allclose([got[k] for k in sorted(ref)],
+                               [ref[k] for k in sorted(ref)],
+                               rtol=1e-3, atol=2e-5)
+    assert one["numRows"] == big["numRows"] == 300
+
+
+def _assert_stream_train_telemetry(out_dir, summary, feeder):
+    info = summary["streamTrain"]
+    assert info["feeder"]["decode_path"] == feeder
+    for key in ("mode", "batchRows", "hbmBudgetBytes", "feeder", "cache"):
+        assert key in info, key
+    if info["cache"] is not None:
+        for key in ("hits", "misses", "evictions", "bytes_reuploaded",
+                    "peak_device_bytes", "bucket_shapes"):
+            assert key in info["cache"], key
+        assert "traceBudgets" in info and "traceCounts" in info
+        for name, count in info["traceCounts"].items():
+            assert count <= info["traceBudgets"][name], name
+    # the telemetry must round-trip through the metrics.json artifact
+    on_disk = json.loads((out_dir / "metrics.json").read_text())
+    assert on_disk["streamTrain"] == json.loads(json.dumps(info))
+
+
+def test_stream_train_smoke_python_feeder(tmp_path, rng):
+    """Tier-1 smoke: end-to-end --stream-train on a tiny generated Avro
+    file with the forced-python feeder, asserting metrics.json telemetry
+    keys, in both resident and spill modes."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=90)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE
+    s_res = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "res"), "--stream-train",
+                "--batch-rows", "32", "--feeder", "python"])
+    _assert_stream_train_telemetry(tmp_path / "res", s_res, "python")
+    s_spill = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "spill"), "--stream-train",
+                "--batch-rows", "32", "--feeder", "python",
+                "--hbm-budget", "4K"])
+    _assert_stream_train_telemetry(tmp_path / "spill", s_spill, "python")
+    assert s_spill["streamTrain"]["mode"] == "spill"
+
+
+@pytest.mark.native_decoder
+def test_stream_train_smoke_native_feeder(tmp_path, rng):
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=90)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE
+    summary = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "out"), "--stream-train",
+                "--batch-rows", "32", "--feeder", "native",
+                "--hbm-budget", "1M"])
+    _assert_stream_train_telemetry(tmp_path / "out", summary, "native")
+
+
+def test_stream_train_streamed_validation_matches_one_shot(tmp_path, rng):
+    """Validation goes through StreamingGameScorer.score_container_stream
+    (bounded by --batch-rows) and reproduces the one-shot driver's
+    validation metrics; grid selection uses the streamed metric."""
+    train = tmp_path / "train"
+    valid = tmp_path / "valid"
+    _write_sparse_fe_avro(train, rng, n=300)
+    _write_sparse_fe_avro(valid, rng, n=130, offset=300)
+    grid = [
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:25,1e-7,10.0,1.0,LBFGS,L2|25,1e-7,0.1,1.0,LBFGS,L2",
+        "--updating-sequence", "fixed",
+        "--evaluators", "AUC,LOGISTIC_LOSS",
+        "--train-input-dirs", str(train),
+        "--validate-input-dirs", str(valid),
+    ]
+    one = game_training_driver.run(grid + ["--output-dir",
+                                           str(tmp_path / "one")])
+    st = game_training_driver.run(
+        grid + ["--output-dir", str(tmp_path / "stream"), "--stream-train",
+                "--batch-rows", "48"])
+    assert st["numCombos"] == one["numCombos"] == 2
+    assert st["bestConfigs"] == one["bestConfigs"]
+    for name, v in one["validationHistory"][-1].items():
+        np.testing.assert_allclose(st["validationHistory"][-1][name], v,
+                                   rtol=1e-6, atol=1e-7)
+    # the winning streamed model is the winning one-shot model, exactly
+    assert _coeff_records(tmp_path / "one") == \
+        _coeff_records(tmp_path / "stream")
+
+
+def test_stream_train_rejects_random_effects(tmp_path, rng):
+    train = tmp_path / "train"
+    _write_game_avro(train, rng, n=40)
+    with pytest.raises(ValueError, match="one fixed-effect"):
+        game_training_driver.run([
+            "--train-input-dirs", str(train),
+            "--output-dir", str(tmp_path / "o"),
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--fixed-effect-data-configurations", "fixed:global",
+            "--fixed-effect-optimization-configurations",
+            "fixed:10,1e-6,1.0,1.0,LBFGS,L2",
+            "--random-effect-data-configurations",
+            "perUser:userId,global,4,-1,-1,-1",
+            "--random-effect-optimization-configurations",
+            "perUser:10,1e-6,1.0,1.0,LBFGS,L2",
+            "--updating-sequence", "fixed,perUser",
+            "--stream-train"])
+
+
 def test_multihost_initialize_noop_single_host():
     from photon_ml_tpu.parallel import initialize_multihost, is_primary_host
 

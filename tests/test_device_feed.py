@@ -1,0 +1,112 @@
+"""Direct tests for data/device_feed.py HostPrefetcher shutdown paths
+(previously exercised only indirectly through test_block_stream.py):
+consumer close() mid-stream, producer exceptions after partial
+consumption, and generator abandonment must all stop the producer thread
+promptly instead of leaving it blocked on a full queue forever.
+"""
+
+import gc
+import threading
+import time
+
+import pytest
+
+from photon_ml_tpu.data.device_feed import HostPrefetcher
+
+
+class _CountingSource:
+    """Unbounded source that records how far production got."""
+
+    def __init__(self, n=10**9, delay=0.0):
+        self.produced = 0
+        self.n = n
+        self.delay = delay
+        self.exited = threading.Event()
+
+    def __iter__(self):
+        try:
+            for i in range(self.n):
+                if self.delay:
+                    time.sleep(self.delay)
+                self.produced += 1
+                yield i
+        finally:
+            self.exited.set()
+
+
+def _assert_stops(src, timeout=3.0):
+    """Producer must halt: `produced` stabilizes well below the source
+    length within the poll-stop window."""
+    deadline = time.monotonic() + timeout
+    last = -1
+    while time.monotonic() < deadline:
+        now = src.produced
+        if now == last:
+            return now
+        last = now
+        time.sleep(3 * HostPrefetcher._POLL_S)
+    raise AssertionError(f"producer still running: produced={src.produced}")
+
+
+def test_close_mid_stream_stops_producer():
+    src = _CountingSource()
+    pf = HostPrefetcher(src, depth=2)
+    it = iter(pf)
+    assert next(it) == 0
+    assert next(it) == 1
+    it.close()  # consumer walks away mid-stream
+    final = _assert_stops(src)
+    # Bounded overrun: queue depth + producer's hand, not the whole
+    # source (the poll-stop flag is checked on every blocked put).
+    assert final <= 2 + 2 + 2
+
+
+def test_generator_abandonment_stops_producer():
+    src = _CountingSource()
+    it = iter(HostPrefetcher(src, depth=1))
+    assert next(it) == 0
+    del it  # GC finalizes the generator -> finally -> stop flag
+    gc.collect()
+    final = _assert_stops(src)
+    assert final <= 1 + 2 + 2
+
+
+def test_producer_exception_reraised_at_position():
+    def src():
+        yield 1
+        yield 2
+        raise ValueError("decode exploded at block 2")
+
+    it = iter(HostPrefetcher(src(), depth=2))
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(ValueError, match="decode exploded at block 2"):
+        next(it)
+
+
+def test_producer_exception_before_any_item():
+    def src():
+        raise RuntimeError("corrupt header")
+        yield  # pragma: no cover
+
+    with pytest.raises(RuntimeError, match="corrupt header"):
+        next(iter(HostPrefetcher(src(), depth=2)))
+
+
+def test_exhaustion_is_clean_and_ordered():
+    src = _CountingSource(n=7)
+    assert list(HostPrefetcher(src, depth=3)) == list(range(7))
+    assert src.exited.wait(2.0)
+
+
+def test_close_then_new_iteration_is_fresh():
+    """Each __iter__ spins an independent producer; closing one must not
+    poison the next."""
+    src1 = _CountingSource(n=5)
+    pf = HostPrefetcher(src1, depth=1)
+    it = iter(pf)
+    next(it)
+    it.close()
+    _assert_stops(src1)
+    pf2 = HostPrefetcher(_CountingSource(n=4), depth=1)
+    assert list(pf2) == [0, 1, 2, 3]

@@ -1,0 +1,245 @@
+"""data/shard_cache.py: streamed ingest -> exact assembly / padded device
+cache with LRU spill. The assembly contract (bitwise equality with the
+one-shot `fixed_effect_batch`) is what makes `--stream-train` write a
+byte-identical model to the one-shot driver.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.shard_cache import (
+    DeviceShardCache,
+    assemble_fixed_effect_batch,
+)
+
+
+class FakeStream:
+    """GameDataset batches cut from one host matrix — the BlockGameStream
+    shape without Avro (decode identity is test_block_stream's job)."""
+
+    def __init__(self, X, y, batch_rows, offsets=None, weights=None):
+        self.X = sp.csr_matrix(X)
+        self.y = np.asarray(y, float)
+        self.offsets = offsets
+        self.weights = weights
+        self.batch_rows = batch_rows
+
+    def __iter__(self):
+        n = self.X.shape[0]
+        for s in range(0, n, self.batch_rows):
+            e = min(n, s + self.batch_rows)
+            yield GameDataset.build(
+                responses=self.y[s:e], feature_shards={"g": self.X[s:e]},
+                offsets=None if self.offsets is None else self.offsets[s:e],
+                weights=None if self.weights is None else self.weights[s:e])
+
+    def stats(self):
+        return {"decode_path": "fake", "batches": -1}
+
+
+@pytest.fixture
+def problem(rng):
+    n, d = 517, 37
+    X = sp.random(n, d, density=0.08, random_state=5, format="csr")
+    X.data[:] = rng.normal(0, 1, X.nnz)
+    y = (rng.random(n) < 0.5).astype(float)
+    off = rng.normal(0, 0.2, n)
+    w = rng.gamma(1.0, 1.0, n)
+    return X, y, off, w
+
+
+def _one_shot_batch(X, y, off, w, dtype=jnp.float32):
+    data = GameDataset.build(responses=y, feature_shards={"g": X},
+                             offsets=off, weights=w)
+    return data.fixed_effect_batch("g", dtype=dtype)
+
+
+def _tobytes(a):
+    return np.asarray(a).tobytes()
+
+
+@pytest.mark.parametrize("batch_rows", [64, 33, 517, 1000])
+def test_assembly_bitwise_equals_one_shot_sparse(problem, batch_rows):
+    """CSR regime (density < threshold): values/col_ids/row_ids and the
+    row columns must be the one-shot arrays bit for bit, for aligned,
+    non-aligned, exact and oversized batch_rows."""
+    X, y, off, w = problem
+    ref = _one_shot_batch(X, y, off, w)
+    shim = assemble_fixed_effect_batch(
+        FakeStream(X, y, batch_rows, off, w), "g")
+    got = shim.fixed_effect_batch("g")
+    assert type(got.features) is type(ref.features)
+    for name in ("values", "col_ids", "row_ids"):
+        assert _tobytes(getattr(got.features, name)) == \
+            _tobytes(getattr(ref.features, name)), name
+    for name in ("labels", "offsets", "weights"):
+        assert _tobytes(getattr(got, name)) == _tobytes(getattr(ref, name))
+    assert shim.num_rows == X.shape[0]
+    assert shim.feature_shards["g"].shape == X.shape
+
+
+def test_assembly_bitwise_equals_one_shot_dense(rng):
+    """Dense regime (density >= threshold): the device-side scatter of
+    the exact CSR pieces must reproduce the host densify-then-upload
+    array bit for bit."""
+    n, d = 211, 12
+    X = sp.csr_matrix(rng.normal(0, 1, (n, d)) *
+                      (rng.random((n, d)) < 0.6))
+    y = (rng.random(n) < 0.5).astype(float)
+    ref = _one_shot_batch(X, y, None, None)
+    shim = assemble_fixed_effect_batch(FakeStream(X, y, 50), "g")
+    got = shim.fixed_effect_batch("g")
+    assert type(got.features) is type(ref.features)  # DenseFeatures
+    assert _tobytes(got.features.x) == _tobytes(ref.features.x)
+
+
+def test_shim_rejects_wrong_shard_and_dtype(problem):
+    X, y, off, w = problem
+    shim = assemble_fixed_effect_batch(FakeStream(X, y, 64, off, w), "g")
+    with pytest.raises(KeyError, match="assembled shard"):
+        shim.fixed_effect_batch("other")
+    with pytest.raises(ValueError, match="assembled as"):
+        shim.fixed_effect_batch("g", dtype=jnp.float16)
+
+
+def test_cache_padding_and_residency(problem):
+    X, y, off, w = problem
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g")
+    assert cache.n_rows == X.shape[0]
+    assert cache.n_shards == 6  # ceil(517/100)
+    for e in cache.entries:
+        assert e.rows_bucket >= e.n_rows
+        assert e.rows_bucket & (e.rows_bucket - 1) == 0  # pow2
+        assert e.nnz_bucket >= e.nnz
+        assert e.feats is not None  # unbounded -> fully resident
+        assert e.host_values is None  # spill buffers freed
+        # padded row columns carry weight 0 beyond the true rows
+        wts = np.asarray(e.weights)
+        assert (wts[e.n_rows:] == 0).all()
+    # replay is pure hits
+    list(cache.blocks())
+    s = cache.stats()
+    assert s["hits"] == cache.n_shards and s["misses"] == 0
+    assert s["evictions"] == 0
+
+
+def test_cache_spill_reupload_bitwise(problem):
+    """Eviction + prefetched re-upload must reproduce the evicted arrays
+    exactly — residency can never change a partial."""
+    X, y, off, w = problem
+    resident = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g")
+    block_bytes = max(e.feature_bytes for e in resident.entries)
+    spill = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g",
+        hbm_budget_bytes=2 * block_bytes)
+    assert spill.stats()["resident_shards"] < spill.n_shards
+    got = {b.index: b for b in spill.blocks()}
+    for e_ref in resident.entries:
+        b = got[e_ref.index]
+        for name in ("values", "col_ids", "row_ids"):
+            assert _tobytes(getattr(b.feats, name)) == \
+                _tobytes(getattr(e_ref.feats, name))
+    s = spill.stats()
+    assert s["misses"] > 0 and s["evictions"] > 0
+    assert s["bytes_reuploaded"] == s["misses"] * block_bytes \
+        or s["bytes_reuploaded"] > 0
+    # cache-accounted bytes stay at/below budget once the epoch settles
+    assert spill.device_bytes <= max(2 * block_bytes,
+                                     max(e.feature_bytes
+                                         for e in spill.entries))
+
+
+def test_cache_minimal_budget_keeps_only_in_hand_block(problem):
+    """Budget below one block: exactly the in-hand block stays resident
+    (you cannot accumulate a block that is not there)."""
+    X, y, off, w = problem
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g", hbm_budget_bytes=1)
+    for expect, b in enumerate(cache.blocks(prefetch_depth=0)):
+        assert b.index == expect
+        resident = [e.index for e in cache.entries if e.feats is not None]
+        assert resident == [expect]
+
+
+def test_cache_ingest_respects_budget(problem):
+    """Evict-as-you-go: ingest-peak device bytes stay O(budget + one
+    block), never O(dataset) — the --hbm-budget contract must hold
+    DURING ingest, which is exactly when the dataset does not fit."""
+    X, y, off, w = problem
+    resident = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g")
+    block = max(e.feature_bytes for e in resident.entries)
+    budget = 2 * block
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g", hbm_budget_bytes=budget)
+    assert cache.stats()["evictions"] > 0  # evicted while ingesting
+    assert cache.peak_device_bytes <= budget + block
+    assert cache.device_bytes <= budget
+
+
+def test_cache_replay_aware_eviction_beats_lru_thrash(problem):
+    """Budget one block short of full residency, EQUAL block sizes (the
+    policy's worst case): plain LRU would miss on EVERY access (the
+    least-recently-used block is always the next one needed on a cyclic
+    scan, n misses/epoch); the replay-aware policy amortizes to
+    1 + 1/(n-1) misses/epoch (the in-hand block must stay cached, so
+    the resident hole walks and pays one extra miss per wrap)."""
+    X, y, off, w = problem
+    X, y, off, w = X[:500], y[:500], off[:500], w[:500]  # 5 equal shards
+    resident = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g")
+    n = resident.n_shards
+    sizes = {e.feature_bytes for e in resident.entries}
+    assert len(sizes) == 1  # equal blocks — the worst case for the bound
+    per_block = sizes.pop()
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g",
+        hbm_budget_bytes=(n - 1) * per_block)
+    epochs = 2 * (n - 1)  # two full wrap cycles
+    for _ in range(epochs):
+        list(cache.blocks(prefetch_depth=0))
+    s = cache.stats()
+    bound = epochs + -(-epochs // (n - 1))  # 1/epoch + 1 extra per wrap
+    assert s["misses"] <= bound, (s["misses"], bound)
+    assert s["hits"] >= epochs * n - bound
+    # LRU would have missed on every single access:
+    assert s["misses"] < epochs * n / 2
+
+
+def test_cache_snapshot_survives_eviction(problem):
+    """A handed-out block must stay usable even after the cache evicts
+    it (the snapshot holds its own reference)."""
+    X, y, off, w = problem
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g", hbm_budget_bytes=1)
+    blocks = list(cache.blocks(prefetch_depth=2))  # prefetch races evicts
+    assert len(blocks) == cache.n_shards
+    for b in blocks:
+        assert b.feats is not None
+        np.asarray(b.feats.values)  # still materializable
+
+
+def test_cache_stats_keys(problem):
+    X, y, off, w = problem
+    cache = DeviceShardCache.from_stream(FakeStream(X, y, 200, off, w),
+                                         "g", hbm_budget_bytes=10 << 20)
+    s = cache.stats()
+    for key in ("hits", "misses", "evictions", "bytes_reuploaded",
+                "epochs", "shards", "rows", "bucket_shapes",
+                "hbm_budget_bytes", "device_bytes", "peak_device_bytes",
+                "resident_shards"):
+        assert key in s, key
+
+
+def test_empty_stream_raises():
+    X = sp.csr_matrix((0, 4))
+    with pytest.raises(ValueError, match="no rows"):
+        assemble_fixed_effect_batch(FakeStream(X, np.zeros(0), 10), "g")
+    with pytest.raises(ValueError, match="no rows"):
+        DeviceShardCache.from_stream(FakeStream(X, np.zeros(0), 10), "g")
