@@ -1187,6 +1187,60 @@ def stream_scoring_bench():
         pass
     dispatch_rps = n / (time.perf_counter() - t0)
 
+    # -- telemetry cost + snapshot (PR 6) ---------------------------------
+    # Headline numbers above ran with telemetry DISABLED (the default):
+    # the instrumentation cost there is span()/inc() no-op calls. Measure
+    # (a) a back-to-back disabled vs ENABLED pair on the best feeder, (b)
+    # the no-op fast-path cost per call, and derive the disabled-mode
+    # overhead estimate = observed call count x no-op cost / runtime —
+    # the honest form of the "<2% rows/s regression" gate (there is no
+    # uninstrumented binary left to diff against). Attach the registry
+    # snapshot + stage attribution from the enabled run.
+    import photon_ml_tpu.telemetry as telemetry
+
+    tele_feeder = "native" if native_ok else "python"
+    tele_depth = 2 if native_ok else 0
+    dis_rps, _ = run_stream(tele_feeder, tele_depth)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        en_rps, _ = run_stream(tele_feeder, tele_depth)
+        snap = telemetry.snapshot()
+        attribution = telemetry.stage_attribution()
+        mutation_calls = telemetry.registry().mutation_calls()
+    finally:
+        telemetry.disable()
+    span_calls = sum(v["count"] for v in attribution.values())
+    noop_n = 200_000
+    noop_counter = telemetry.counter("bench.noop")
+    t0 = time.perf_counter()
+    for _ in range(noop_n):
+        with telemetry.span("bench_noop"):
+            pass
+    span_ns = (time.perf_counter() - t0) / noop_n * 1e9
+    t0 = time.perf_counter()
+    for _ in range(noop_n):
+        noop_counter.inc()
+    inc_ns = (time.perf_counter() - t0) / noop_n * 1e9
+    disabled_overhead = ((span_calls * span_ns + mutation_calls * inc_ns)
+                         * 1e-9 / (n / dis_rps))
+    telemetry.reset()
+    tele = {
+        "disabled_rows_per_sec": round(dis_rps),
+        "enabled_rows_per_sec": round(en_rps),
+        "enabled_overhead_frac": round(1.0 - en_rps / dis_rps, 4),
+        "noop_span_ns": round(span_ns, 1),
+        "noop_mutation_ns": round(inc_ns, 1),
+        "telemetry_calls_per_run": span_calls + mutation_calls,
+        "disabled_overhead_frac_est": round(disabled_overhead, 6),
+        "disabled_overhead_lt_2pct": bool(disabled_overhead < 0.02),
+        "registry_snapshot": snap,
+        "stage_attribution": {
+            k: {"count": v["count"], "total_s": round(v["total_s"], 4),
+                "self_s": round(v["self_s"], 4)}
+            for k, v in attribution.items()},
+    }
+
     best = c_pre_rps if c_pre_rps else py_rps
     return {
         "python_feeder_rows_per_sec": round(py_rps),
@@ -1202,6 +1256,7 @@ def stream_scoring_bench():
         "prefetch_depth": 2,
         "batch_rows": batch_rows,
         "rows": n,
+        "telemetry": tele,
         "cpu_cores": cpu_cores,
         "peak_rss_mb_process_cumulative": _peak_rss_mb(),
         "model": "fixed + per-user RE + per-item RE + factored per-item "

@@ -357,11 +357,76 @@ class NondeterministicPytreeRule:
         return out
 
 
+class TelemetryInTraceRule:
+    """Telemetry belongs to the HOST loop: a span opened inside traced
+    code measures trace time once and nothing on later dispatches (and a
+    registry mutation there runs at trace time, not per call) — both
+    silently lie. Device work is attributed at the dispatch boundary via
+    the block_until_ready that already exists in host-sync code
+    (docs/OBSERVABILITY.md span rules)."""
+
+    id = "telemetry-in-trace"
+    doc = ("telemetry span()/timed_span() or metric mutation "
+           "(.inc()/.observe()) inside jit-reachable code")
+
+    # photon_ml_tpu.telemetry entry points that open spans / create
+    # metrics; resolved through the import table so local helpers named
+    # `span` in unrelated modules do not trip the rule.
+    _FACTORIES = ("span", "timed_span", "counter", "gauge", "histogram")
+    # Metric mutation methods — distinctive enough to flag on name alone
+    # (nothing else in the tree defines .inc/.observe).
+    _MUTATORS = ("inc", "observe")
+
+    def check(self, mod: ModuleSource, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not project.in_traced_code(mod, node):
+                continue
+            v = self._check_call(mod, node)
+            if v is not None:
+                out.append(v)
+        return out
+
+    def _check_call(self, mod: ModuleSource,
+                    call: ast.Call) -> Optional[Violation]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            fq = mod.imports.get(f.id, "")
+            if f.id in self._FACTORIES \
+                    and fq.startswith("photon_ml_tpu.telemetry"):
+                return mod.violation(
+                    call, self.id,
+                    f"telemetry {f.id}() opened inside traced code: it "
+                    "would measure trace time once and nothing per "
+                    "dispatch — instrument the host loop that launches "
+                    "the device work (attribute device time at an "
+                    "existing block_until_ready boundary)")
+        elif isinstance(f, ast.Attribute):
+            if f.attr in self._MUTATORS:
+                return mod.violation(
+                    call, self.id,
+                    f".{f.attr}() metric mutation inside traced code "
+                    "runs at trace time, not per call — move it to the "
+                    "host loop")
+            if f.attr in self._FACTORIES and isinstance(f.value, ast.Name):
+                target = mod.imports.get(f.value.id, "")
+                if target.startswith("photon_ml_tpu.telemetry") \
+                        or target == "photon_ml_tpu.telemetry":
+                    return mod.violation(
+                        call, self.id,
+                        f"telemetry {f.attr}() opened inside traced "
+                        "code — instrument the host loop instead")
+        return None
+
+
 ALL_RULES = (
     RetraceHazardRule(),
     HostSyncRule(),
     DtypeDriftRule(),
     NondeterministicPytreeRule(),
+    TelemetryInTraceRule(),
 )
 
 RULE_IDS = tuple(r.id for r in ALL_RULES)

@@ -29,11 +29,13 @@ from pathlib import Path
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.avro_reader import read_game_dataset
 from photon_ml_tpu.evaluation import build_evaluator
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import write_container
 from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.utils.date_range import resolve_input_dirs
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
 
@@ -74,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "background thread (0 = synchronous decode; "
                         "peak resident batches stay bounded by this "
                         "depth + 2)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON of the run's "
+                        "pipeline spans here (load in Perfetto — "
+                        "docs/OBSERVABILITY.md)")
     return p
 
 
@@ -127,62 +133,117 @@ def run(argv=None) -> dict:
     out_dir.mkdir(parents=True, exist_ok=True)
     logger = setup_photon_logger(out_dir)
     t0 = time.perf_counter()
+    # Per-run telemetry: phase spans + registry snapshot in metrics.json
+    # (plus --trace-out for Perfetto) — docs/OBSERVABILITY.md.
+    telemetry.reset()
+    telemetry.enable(trace=bool(args.trace_out))
 
+    try:
+        # Root span: module imports, logging, and glue between the named
+        # phases land in `driver` SELF time — the stage table sums to
+        # the whole run (attributed_wall_frac >= 0.9 even on millisecond
+        # runs) instead of leaving silent gaps.
+        with span("driver"):
+            summary = _run_scoring(args, out_dir, logger)
+
+        wall = time.perf_counter() - t0
+        summary["total_seconds"] = wall
+        _apply_legacy_aliases(summary)
+        summary["telemetry"] = telemetry.attribution_summary(wall)
+        if args.trace_out:
+            telemetry.export_chrome_trace(args.trace_out)
+            logger.info("pipeline trace written to %s (load in Perfetto)",
+                        args.trace_out)
+        (out_dir / "metrics.json").write_text(
+            json.dumps(summary, indent=2))
+        logger.info("scoring done: %s", summary["metrics"])
+        return summary
+    finally:
+        # Exception (incl. the --stream SystemExit paths) or not: don't
+        # leave a process-wide recorder armed for whatever runs next in
+        # this process.
+        telemetry.disable()
+
+
+# snake_case canonical -> deprecated camelCase alias, kept one release
+# behind (docs/OBSERVABILITY.md §Schema) — ONE table, so a new key can't
+# silently miss its twin.
+_LEGACY_ALIASES = {
+    "num_rows": "numRows",
+    "num_batches": "numBatches",
+    "batch_rows": "batchRows",
+    "scoring_path": "scoringPath",
+    "total_seconds": "totalSeconds",
+}
+
+
+def _apply_legacy_aliases(summary: dict) -> dict:
+    for snake, camel in _LEGACY_ALIASES.items():
+        if snake in summary:
+            summary[camel] = summary[snake]
+    return summary
+
+
+def _run_scoring(args, out_dir, logger) -> dict:
     from photon_ml_tpu.data.paldb import load_feature_index_maps
 
     model_dir = Path(args.game_model_input_dir)
     index_dir = Path(args.feature_index_dir) if args.feature_index_dir else \
         model_dir / "feature-indexes"
-    shard_maps = load_feature_index_maps(index_dir)
-    model = load_game_model(model_dir, shard_maps)
+    with span("load_model"):
+        shard_maps = load_feature_index_maps(index_dir)
+        model = load_game_model(model_dir, shard_maps)
 
-    meta = json.loads((model_dir / "model-metadata.json").read_text())
-    id_types = sorted(
-        {c["randomEffectType"] for c in meta["coordinates"]
-         if c["kind"] == "random"} |
-        # MF coordinates key rows by both their entity axes.
-        {c[k] for c in meta["coordinates"] if c["kind"] == "mf"
-         for k in ("rowEffectType", "colEffectType")} |
-        {s.strip() for s in (args.id_types or "").split(",") if s.strip()})
+    with span("setup"):
+        meta = json.loads((model_dir / "model-metadata.json").read_text())
+        id_types = sorted(
+            {c["randomEffectType"] for c in meta["coordinates"]
+             if c["kind"] == "random"} |
+            # MF coordinates key rows by both their entity axes.
+            {c[k] for c in meta["coordinates"] if c["kind"] == "mf"
+             for k in ("rowEffectType", "colEffectType")} |
+            {s.strip() for s in (args.id_types or "").split(",")
+             if s.strip()})
 
-    inputs = resolve_input_dirs(
-        args.input_dirs, date_range=args.date_range,
-        date_range_days_ago=args.date_range_days_ago)
+        inputs = resolve_input_dirs(
+            args.input_dirs, date_range=args.date_range,
+            date_range_days_ago=args.date_range_days_ago)
 
-    evaluators = [build_evaluator(s.strip())
-                  for s in (args.evaluators or "").split(",") if s.strip()]
-    scores_dir = out_dir / "scores"
-    scores_dir.mkdir(exist_ok=True)
-    scores_path = scores_dir / "part-00000.avro"
+        evaluators = [build_evaluator(s.strip())
+                      for s in (args.evaluators or "").split(",")
+                      if s.strip()]
+        scores_dir = out_dir / "scores"
+        scores_dir.mkdir(exist_ok=True)
+        scores_path = scores_dir / "part-00000.avro"
 
     if args.stream:
         summary = _run_stream(args, inputs, id_types, shard_maps, model,
                               evaluators, scores_path, logger)
     else:
-        data, _ = read_game_dataset(inputs, id_types=id_types,
-                                    feature_shard_maps=shard_maps)
-        scores, path_used = _device_scores(model, data, logger)
+        with span("ingest"):
+            data, _ = read_game_dataset(inputs, id_types=id_types,
+                                        feature_shard_maps=shard_maps)
+        with span("score"):
+            scores, path_used = _device_scores(model, data, logger)
         logger.info("scored %d rows (%s path)", data.num_rows, path_used)
 
-        uids = data.uids if data.uids is not None else \
-            np.asarray([str(i) for i in range(data.num_rows)])
-        write_container(
-            scores_path, schemas.SCORING_RESULT,
-            [{"uid": str(u), "predictionScore": float(s + o),
-              "label": float(l), "metadataMap": None}
-             for u, s, o, l in zip(uids, scores, data.offsets,
-                                   data.responses)])
-        metrics = {ev.name: ev.evaluate_dataset(scores, data)
-                   for ev in evaluators}
+        with span("write_scores"):
+            uids = data.uids if data.uids is not None else \
+                np.asarray([str(i) for i in range(data.num_rows)])
+            write_container(
+                scores_path, schemas.SCORING_RESULT,
+                [{"uid": str(u), "predictionScore": float(s + o),
+                  "label": float(l), "metadataMap": None}
+                 for u, s, o, l in zip(uids, scores, data.offsets,
+                                       data.responses)])
+        with span("evaluate"):
+            metrics = {ev.name: ev.evaluate_dataset(scores, data)
+                       for ev in evaluators}
         summary = {
-            "numRows": int(data.num_rows),
+            "num_rows": int(data.num_rows),
             "metrics": metrics,
-            "scoringPath": path_used,
+            "scoring_path": path_used,
         }
-
-    summary["totalSeconds"] = time.perf_counter() - t0
-    (out_dir / "metrics.json").write_text(json.dumps(summary, indent=2))
-    logger.info("scoring done: %s", summary["metrics"])
     return summary
 
 
@@ -199,16 +260,20 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
     from photon_ml_tpu.serving import StreamingGameScorer
 
     try:
-        engine = StreamingGameScorer(model, dtype=_scoring_dtype())
+        with span("setup_engine"):
+            engine = StreamingGameScorer(model, dtype=_scoring_dtype())
     except TypeError as e:
         raise SystemExit(
             f"--stream requires a device-scorable model: {e}") from e
 
     try:
-        scored = engine.score_container_stream(
-            inputs, id_types=id_types, feature_shard_maps=shard_maps,
-            batch_rows=args.batch_rows, feeder=args.feeder,
-            prefetch_depth=args.prefetch_batches)
+        # Stream construction scans the container block index (real I/O)
+        # — covered so tiny runs still attribute >= 90% of wall time.
+        with span("setup_stream"):
+            scored = engine.score_container_stream(
+                inputs, id_types=id_types, feature_shard_maps=shard_maps,
+                batch_rows=args.batch_rows, feeder=args.feeder,
+                prefetch_depth=args.prefetch_batches)
     except RuntimeError as e:
         raise SystemExit(str(e)) from e
     logger.info("streamed scoring: %s feeder, prefetch depth %d",
@@ -230,17 +295,23 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
                 yield {"uid": str(u), "predictionScore": float(s + o),
                        "label": float(l), "metadataMap": None}
 
-    write_container(scores_path, schemas.SCORING_RESULT, scored_records())
+    # One phase span over the whole pipeline consumption; the per-stage
+    # split (decode / featureize / dispatch / device_wait / ...) nests
+    # inside it, decode on the prefetch thread's own trace track.
+    with span("score"):
+        write_container(scores_path, schemas.SCORING_RESULT,
+                        scored_records())
     logger.info("scored %d rows in %d streamed batches (batch-rows=%d)",
                 counters["rows"], counters["batches"], args.batch_rows)
 
-    metrics = acc.metrics(evaluators) if acc is not None else {}
+    with span("evaluate"):
+        metrics = acc.metrics(evaluators) if acc is not None else {}
     return {
-        "numRows": counters["rows"],
+        "num_rows": counters["rows"],
         "metrics": metrics,
-        "scoringPath": "streaming-engine",
-        "numBatches": counters["batches"],
-        "batchRows": args.batch_rows,
+        "scoring_path": "streaming-engine",
+        "num_batches": counters["batches"],
+        "batch_rows": args.batch_rows,
         "feeder": scored.stream.stats(),
         "engine": engine.stats(),
     }

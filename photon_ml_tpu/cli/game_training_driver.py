@@ -20,6 +20,7 @@ import sys
 import time
 from pathlib import Path
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.avro_reader import read_game_dataset
 from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
 from photon_ml_tpu.estimators.game_estimator import (
@@ -34,8 +35,15 @@ from photon_ml_tpu.optimization.config import (
     FactoredRandomEffectOptimizationConfiguration,
     GLMOptimizationConfiguration,
 )
+from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.types import TaskType
 from photon_ml_tpu.utils.date_range import resolve_input_dirs
+from photon_ml_tpu.utils.events import (
+    EventEmitter,
+    PhotonOptimizationLogEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
 from photon_ml_tpu.utils.logging_utils import setup_photon_logger
 from photon_ml_tpu.utils.profiling import maybe_trace
 
@@ -167,6 +175,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch-batches", type=int, default=2,
                    help="decode-ahead depth of the --stream-train feeder "
                         "(and spill re-upload look-ahead); 0 disables")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON of the run's "
+                        "pipeline spans here (load in Perfetto — "
+                        "docs/OBSERVABILITY.md)")
+    p.add_argument("--job-name", default="photon-game-training",
+                   help="job name carried on Training{Start,Finish} "
+                        "events")
+    p.add_argument("--event-listeners", default=None,
+                   help="comma-separated EventListener class paths "
+                        "registered by name (utils/events.py) — the "
+                        "reference's listener registration, e.g. "
+                        "my.module.MyListener")
     return p
 
 
@@ -180,7 +200,44 @@ def run(argv=None) -> dict:
     logger = setup_photon_logger(out_dir)
     task = TaskType(args.task_type)
     t0 = time.perf_counter()
+    # The driver owns this process's telemetry: per-run metrics + stage
+    # spans land in metrics.json (and --trace-out); library code is
+    # instrumented but silent outside a driver (docs/OBSERVABILITY.md).
+    telemetry.reset()
+    telemetry.enable(trace=bool(args.trace_out))
 
+    emitter = EventEmitter()
+    try:
+        for cp in (args.event_listeners or "").split(","):
+            if cp.strip():
+                emitter.register_listener_by_name(cp.strip())
+        emitter.send_event(TrainingStartEvent(args.job_name))
+        # Root span: config parsing, event emission, and glue between
+        # the named phases land in `driver` SELF time (same scheme as
+        # the scoring driver), so the stage table sums to the whole run
+        # even on millisecond runs.
+        with span("driver"):
+            (sequence, results, best_configs, best_result, shard_maps,
+             num_rows, stream_info) = _run_training(
+                args, logger, task, emitter)
+            _save_outputs(args, out_dir, logger, sequence, results,
+                          best_configs, best_result, shard_maps)
+        summary = _write_summary(args, out_dir, logger, task, sequence,
+                                 t0, results, best_configs, best_result,
+                                 num_rows, stream_info)
+        emitter.send_event(
+            TrainingFinishEvent(args.job_name, summary["totalSeconds"]))
+        return summary
+    finally:
+        # Exception or not: close listeners and disarm the process-wide
+        # recorder so whatever runs next in this process starts clean.
+        emitter.clear_listeners()
+        telemetry.disable()
+
+
+def _run_training(args, logger, task, emitter):
+    """Config parse + train (one-shot estimator or --stream-train);
+    returns everything the save/summary tail needs."""
     fe_data = _parse_named(args.fixed_effect_data_configurations,
                            "fixed-effect data config")
     fe_opt = _parse_named(args.fixed_effect_optimization_configurations,
@@ -253,26 +310,28 @@ def run(argv=None) -> dict:
             (results, best_configs, best_result, shard_maps, num_rows,
              stream_info) = _stream_train(
                 args, logger, task, fe_data, fe_opt, sequence,
-                train_inputs, evaluators, preloaded_maps, opt_grid)
-        return _finish(args, out_dir, logger, task, sequence, t0, results,
-                       best_configs, best_result, shard_maps, num_rows,
-                       stream_info)
+                train_inputs, evaluators, preloaded_maps, opt_grid,
+                emitter)
+        return (sequence, results, best_configs, best_result, shard_maps,
+                num_rows, stream_info)
 
     logger.info("reading training data from %s (ingest workers: %s)",
                 train_inputs, args.ingest_workers)
-    data, shard_maps = read_game_dataset(train_inputs, id_types=id_types,
-                                         feature_shard_maps=preloaded_maps,
-                                         ingest_workers=args.ingest_workers)
-    validation = None
-    if args.validate_input_dirs:
-        validate_inputs = resolve_input_dirs(
-            args.validate_input_dirs,
-            date_range=args.validate_date_range,
-            date_range_days_ago=args.validate_date_range_days_ago)
-        validation, _ = read_game_dataset(
-            validate_inputs, id_types=id_types,
-            feature_shard_maps=shard_maps,
+    with span("ingest"):
+        data, shard_maps = read_game_dataset(
+            train_inputs, id_types=id_types,
+            feature_shard_maps=preloaded_maps,
             ingest_workers=args.ingest_workers)
+        validation = None
+        if args.validate_input_dirs:
+            validate_inputs = resolve_input_dirs(
+                args.validate_input_dirs,
+                date_range=args.validate_date_range,
+                date_range_days_ago=args.validate_date_range_days_ago)
+            validation, _ = read_game_dataset(
+                validate_inputs, id_types=id_types,
+                feature_shard_maps=shard_maps,
+                ingest_workers=args.ingest_workers)
 
     specs = []
     for name in sequence:
@@ -322,24 +381,37 @@ def run(argv=None) -> dict:
         task_type=task, coordinate_specs=specs,
         num_iterations=args.num_iterations,
         validation_evaluators=evaluators)
-    with maybe_trace(args.profile_output_dir):
+    with maybe_trace(args.profile_output_dir), span("solve"):
         results = estimator.fit(
             data, validation_data=validation,
             checkpoint_dir=(Path(args.checkpoint_dir)
                             if args.checkpoint_dir else None),
             checkpoint_interval=args.checkpoint_interval)
     best_configs, best_result = estimator.select_best(results)
-    return _finish(args, out_dir, logger, task, sequence, t0, results,
-                   best_configs, best_result, shard_maps,
-                   int(data.num_rows), None)
+    return (sequence, results, best_configs, best_result, shard_maps,
+            int(data.num_rows), None)
 
 
-def _finish(args, out_dir, logger, task, sequence, t0, results,
-            best_configs, best_result, shard_maps, num_rows,
-            stream_info) -> dict:
-    """Model save + metrics.json — shared by the one-shot and
-    --stream-train paths (identical artifacts either way, plus the
-    streaming telemetry block when streaming)."""
+_STREAM_INFO_LEGACY_KEYS = {
+    # snake_case canonical -> deprecated camelCase alias, kept one
+    # release behind (docs/OBSERVABILITY.md §Schema); the legacy
+    # ``streamTrain`` block is built from these.
+    "batch_rows": "batchRows",
+    "hbm_budget_bytes": "hbmBudgetBytes",
+    "trace_budgets": "traceBudgets",
+    "trace_counts": "traceCounts",
+}
+
+
+def _legacy_stream_info(info: dict) -> dict:
+    return {_STREAM_INFO_LEGACY_KEYS.get(k, k): v for k, v in info.items()}
+
+
+def _save_outputs(args, out_dir, logger, sequence, results,
+                  best_configs, best_result, shard_maps) -> None:
+    """Model + index-map save (the ``finalize`` phase) — shared by the
+    one-shot and --stream-train paths (identical artifacts either
+    way)."""
     from photon_ml_tpu.models.tracking import summarize_trackers
 
     # Aggregate per-entity optimizer telemetry (convergence-reason counts,
@@ -356,42 +428,63 @@ def _finish(args, out_dir, logger, task, sequence, t0, results,
                 last["convergenceReasons"], last["iterations"]["mean"],
                 int(last["iterations"]["max"]))
 
-    save_game_model(
-        out_dir / "best", best_result.best_model, shard_maps,
-        metadata_extras={
-            "optimizationConfigurations": {
-                k: v.to_json() for k, v in best_configs.items()},
-            "updatingSequence": sequence,
-            "numIterations": args.num_iterations,
-            "optimizationTrackers": tracker_summary,
-        })
-    # Persist the feature index maps next to the model so the scoring driver
-    # can decode features identically (the reference ships PalDB stores).
-    index_dir = out_dir / "best" / "feature-indexes"
-    index_dir.mkdir(parents=True, exist_ok=True)
-    for shard, imap in shard_maps.items():
-        imap.save(index_dir / f"{shard}.json")
-    if args.save_all_models == "true":
-        for i, (configs, result) in enumerate(results):
-            save_game_model(
-                out_dir / "all" / str(i), result.model, shard_maps,
-                metadata_extras={
-                    "optimizationConfigurations": {
-                        k: v.to_json() for k, v in configs.items()}})
+    with span("finalize"):
+        save_game_model(
+            out_dir / "best", best_result.best_model, shard_maps,
+            metadata_extras={
+                "optimizationConfigurations": {
+                    k: v.to_json() for k, v in best_configs.items()},
+                "updatingSequence": sequence,
+                "numIterations": args.num_iterations,
+                "optimizationTrackers": tracker_summary,
+            })
+        # Persist the feature index maps next to the model so the scoring
+        # driver can decode features identically (the reference ships
+        # PalDB stores).
+        index_dir = out_dir / "best" / "feature-indexes"
+        index_dir.mkdir(parents=True, exist_ok=True)
+        for shard, imap in shard_maps.items():
+            imap.save(index_dir / f"{shard}.json")
+        if args.save_all_models == "true":
+            for i, (configs, result) in enumerate(results):
+                save_game_model(
+                    out_dir / "all" / str(i), result.model, shard_maps,
+                    metadata_extras={
+                        "optimizationConfigurations": {
+                            k: v.to_json() for k, v in configs.items()}})
 
+
+def _write_summary(args, out_dir, logger, task, sequence, t0, results,
+                   best_configs, best_result, num_rows,
+                   stream_info) -> dict:
+    """metrics.json + trace export — runs AFTER the root ``driver`` span
+    closed, so the telemetry block it snapshots includes the root's
+    self time (the otherwise-unattributed driver glue)."""
+    wall = time.perf_counter() - t0
     summary = {
         "taskType": task.value,
         "numRows": num_rows,
+        "num_rows": num_rows,
         "updatingSequence": sequence,
         "numCombos": len(results),
         "bestConfigs": {k: v.to_string() for k, v in best_configs.items()},
         "objectiveHistory": best_result.objective_history,
         "validationHistory": best_result.validation_history,
         "coordinateSeconds": best_result.timings,
-        "totalSeconds": time.perf_counter() - t0,
+        "totalSeconds": wall,
+        "total_seconds": wall,
     }
     if stream_info is not None:
-        summary["streamTrain"] = stream_info
+        # ``stream_train`` is the canonical snake_case schema;
+        # ``streamTrain`` is the deprecated camelCase alias, kept one
+        # release behind (docs/OBSERVABILITY.md §Schema).
+        summary["stream_train"] = stream_info
+        summary["streamTrain"] = _legacy_stream_info(stream_info)
+    summary["telemetry"] = telemetry.attribution_summary(wall)
+    if args.trace_out:
+        telemetry.export_chrome_trace(args.trace_out)
+        logger.info("pipeline trace written to %s (load in Perfetto)",
+                    args.trace_out)
     (out_dir / "metrics.json").write_text(json.dumps(summary, indent=2))
     logger.info("GAME training done in %.1fs", summary["totalSeconds"])
     return summary
@@ -433,7 +526,8 @@ def _stream_validate_many(game_models, args, shard_maps, evaluators,
 
 
 def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
-                  train_inputs, evaluators, preloaded_maps, opt_grid):
+                  train_inputs, evaluators, preloaded_maps, opt_grid,
+                  emitter):
     """Out-of-core training path (--stream-train): block-streamed ingest
     (host memory O(batch_rows)) into either
 
@@ -476,8 +570,9 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
     else:
         logger.info("building feature index for shard %r from %s",
                     shard, train_inputs)
-        shard_maps = {shard: build_index_map(
-            train_inputs, ingest_workers=args.ingest_workers)}
+        with span("build_index"):
+            shard_maps = {shard: build_index_map(
+                train_inputs, ingest_workers=args.ingest_workers)}
 
     def make_stream():
         return BlockGameStream(
@@ -494,23 +589,25 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
         # -- resident: exact assembly + the one-shot estimator ------------
         logger.info("stream-train (resident): assembling %r from %s in "
                     "%d-row batches", shard, train_inputs, args.batch_rows)
-        data = assemble_fixed_effect_batch(make_stream(), shard)
+        with span("ingest"):
+            data = assemble_fixed_effect_batch(make_stream(), shard)
         estimator = GameEstimator(
             task_type=task,
             coordinate_specs=[FixedEffectSpec(
                 name=name, feature_shard_id=shard, configs=grid)],
             num_iterations=args.num_iterations,
             validation_evaluators=evaluators)
-        results = estimator.fit(
-            data, validation_data=None,
-            checkpoint_dir=(Path(args.checkpoint_dir)
-                            if args.checkpoint_dir else None),
-            checkpoint_interval=args.checkpoint_interval)
+        with span("solve"):
+            results = estimator.fit(
+                data, validation_data=None,
+                checkpoint_dir=(Path(args.checkpoint_dir)
+                                if args.checkpoint_dir else None),
+                checkpoint_interval=args.checkpoint_interval)
         num_rows = data.num_rows
         stream_info = {
             "mode": "resident-assembled",
-            "batchRows": args.batch_rows,
-            "hbmBudgetBytes": None,
+            "batch_rows": args.batch_rows,
+            "hbm_budget_bytes": None,
             "feeder": {k: v for k, v in data.ingest_stats.items()},
             "cache": None,
         }
@@ -519,45 +616,65 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
         logger.info("stream-train (spill, hbm budget %d bytes): caching "
                     "%r from %s in %d-row shards", budget, shard,
                     train_inputs, args.batch_rows)
-        cache = DeviceShardCache.from_stream(
-            make_stream(), shard, hbm_budget_bytes=budget,
-            prefetch_depth=max(0, args.prefetch_batches))
+        with span("ingest"):
+            cache = DeviceShardCache.from_stream(
+                make_stream(), shard, hbm_budget_bytes=budget,
+                prefetch_depth=max(0, args.prefetch_batches))
         results = []
         shared = None
-        for cfg in grid:
-            coord = StreamingFixedEffectCoordinate(
-                name=name, cache=cache, feature_shard_id=shard,
-                task_type=task, config=cfg, sharded_objective=shared)
-            shared = coord.sharded_objective
-            t0 = _time.perf_counter()
-            model, trackers, obj_hist = None, [], []
-            for _ in range(args.num_iterations):
-                model, res = coord.solve(model)
-                trackers.append(res)
-                obj_hist.append(float(res.value))
-            gm = GameModel({name: model}, task)
-            results.append(({name: cfg}, CoordinateDescentResult(
-                model=gm, objective_history=obj_hist,
-                validation_history=[], best_model=gm, best_metric=None,
-                trackers={name: trackers},
-                timings={name: _time.perf_counter() - t0})))
+        with span("solve"):
+            for cfg in grid:
+                coord = StreamingFixedEffectCoordinate(
+                    name=name, cache=cache, feature_shard_id=shard,
+                    task_type=task, config=cfg, sharded_objective=shared)
+                shared = coord.sharded_objective
+                t0 = _time.perf_counter()
+                model, trackers, obj_hist = None, [], []
+                for _ in range(args.num_iterations):
+                    model, res = coord.solve(model)
+                    trackers.append(res)
+                    obj_hist.append(float(res.value))
+                gm = GameModel({name: model}, task)
+                results.append(({name: cfg}, CoordinateDescentResult(
+                    model=gm, objective_history=obj_hist,
+                    validation_history=[], best_model=gm,
+                    best_metric=None, trackers={name: trackers},
+                    timings={name: _time.perf_counter() - t0})))
         num_rows = cache.n_rows
         stream_info = {
             "mode": "spill",
-            "batchRows": args.batch_rows,
-            "hbmBudgetBytes": budget,
+            "batch_rows": args.batch_rows,
+            "hbm_budget_bytes": budget,
             "feeder": cache.ingest_stats,
             "cache": cache.stats(),
-            "traceBudgets": shared.trace_budgets(),
-            "traceCounts": shared.guard.counts(),
+            "trace_budgets": shared.trace_budgets(),
+            "trace_counts": shared.guard.counts(),
         }
 
     if args.validate_input_dirs and evaluators:
-        all_metrics = _stream_validate_many(
-            [res.model for _, res in results], args, shard_maps,
-            evaluators, logger)
+        with span("validate"):
+            all_metrics = _stream_validate_many(
+                [res.model for _, res in results], args, shard_maps,
+                evaluators, logger)
         for (_, res), metrics in zip(results, all_metrics):
             res.validation_history.append(metrics)
+
+    # Per-λ optimization telemetry events — the streamed analog of the
+    # glm_driver's per-model PhotonOptimizationLogEvent emission (the
+    # listener registration existed; the streamed path never emitted).
+    for configs, res in results:
+        cfg = configs[name]
+        trk = list(res.trackers.get(name) or [])
+        last = trk[-1] if trk else None
+        emitter.send_event(PhotonOptimizationLogEvent(
+            reg_weight=cfg.regularization_weight,
+            iterations=(int(last.iterations) if last is not None else 0),
+            converged_reason=(last.reason_enum().summary
+                              if last is not None else "unknown"),
+            final_value=(float(last.value) if last is not None
+                         else float("nan")),
+            metrics=(res.validation_history[-1]
+                     if res.validation_history else None)))
 
     from photon_ml_tpu.estimators.game_estimator import select_best_result
 

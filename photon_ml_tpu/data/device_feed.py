@@ -35,6 +35,8 @@ from collections import deque
 
 import numpy as np
 
+from photon_ml_tpu.telemetry import span
+
 # Default per-transfer cap: comfortably under the tunnel's ~300 MB limit
 # while big enough that per-put dispatch overhead stays negligible.
 DEFAULT_CHUNK_BYTES = 128 << 20
@@ -61,7 +63,18 @@ def chunked_device_put(x, dtype=None, device=None,
     on TPU, but donation is ignored on CPU, where every functional
     update would copy the full buffer per chunk — deliberately not done
     until a workload actually hits the 2x ceiling.
+
+    The whole call reports as one ``h2d`` telemetry span: device_put is
+    async, so the span measures host staging + enqueue plus the
+    window-bounding ``block_until_ready`` waits — the H2D stage of the
+    decode -> H2D -> dispatch attribution, charged where the host
+    actually spends the time.
     """
+    with span("h2d"):
+        return _chunked_device_put(x, dtype, device, chunk_bytes, depth)
+
+
+def _chunked_device_put(x, dtype, device, chunk_bytes, depth):
     import jax
     import jax.numpy as jnp
     import scipy.sparse as sp
@@ -141,7 +154,12 @@ class InFlightWindow:
         self._q.append((item, item if ready is None else ready))
         if len(self._q) >= self._depth:
             old_item, old_ready = self._q.popleft()
-            jax.block_until_ready(old_ready)
+            # ``device_wait``: the ONE place device execution meets the
+            # host — this block_until_ready already existed to bound the
+            # window, so a span here attributes device-bound time
+            # without adding a sync (docs/OBSERVABILITY.md span rules).
+            with span("device_wait"):
+                jax.block_until_ready(old_ready)
             return old_item
         return None
 
@@ -151,7 +169,8 @@ class InFlightWindow:
 
         while self._q:
             item, ready = self._q.popleft()
-            jax.block_until_ready(ready)
+            with span("device_wait"):
+                jax.block_until_ready(ready)
             yield item
 
 
@@ -214,7 +233,11 @@ class HostPrefetcher:
         t.start()
         try:
             while True:
-                kind, val = q.get()
+                # ``prefetch_wait``: consumer blocked on the producer —
+                # the feeder-bound share of the stall attribution (its
+                # dual, device-bound, is InFlightWindow's device_wait).
+                with span("prefetch_wait"):
+                    kind, val = q.get()
                 if kind == "done":
                     break
                 if kind == "err":

@@ -44,13 +44,26 @@ from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.device_feed import HostPrefetcher, chunked_device_put
+from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.ops.features import (
     CSRFeatures,
     DENSE_DENSITY_THRESHOLD,
     padded_csr_arrays,
 )
 from photon_ml_tpu.serving.buckets import BucketLadder, next_pow2
+
+# Registry mirrors of the per-instance ``_stats`` (no-ops while
+# telemetry is off); names are part of the metrics.json snapshot schema
+# (docs/OBSERVABILITY.md).
+_M_HITS = telemetry.counter("data.shard_cache.hits")
+_M_MISSES = telemetry.counter("data.shard_cache.misses")
+_M_EVICTIONS = telemetry.counter("data.shard_cache.evictions")
+_M_REUPLOAD_BYTES = telemetry.counter("data.shard_cache.bytes_reuploaded")
+_M_EPOCHS = telemetry.counter("data.shard_cache.epochs")
+_G_DEVICE_BYTES = telemetry.gauge("data.shard_cache.device_bytes")
+_G_PEAK_BYTES = telemetry.gauge("data.shard_cache.peak_device_bytes")
 
 
 def _row_ids_i32(indptr: np.ndarray, offset: int = 0) -> np.ndarray:
@@ -313,24 +326,26 @@ class DeviceShardCache:
                     max_rows=next_pow2(ds.num_rows))
             rb = ladder.rows_bucket(ds.num_rows)
             nb = ladder.nnz_bucket(mat.nnz, rb)
-            values, cols, rows = padded_csr_arrays(
-                mat, rb, nb, value_dtype=dtype)
+            with span("shard_upload"):
+                values, cols, rows = padded_csr_arrays(
+                    mat, rb, nb, value_dtype=dtype)
 
-            def col(x):
-                out = np.zeros(rb, dtype)
-                out[:ds.num_rows] = x
-                return jnp.asarray(out)
+                def col(x):
+                    out = np.zeros(rb, dtype)
+                    out[:ds.num_rows] = x
+                    return jnp.asarray(out)
 
-            e = CachedShard(
-                index=len(entries), n_rows=ds.num_rows, nnz=int(mat.nnz),
-                rows_bucket=rb, nnz_bucket=nb, row_offset=n_rows,
-                labels=col(ds.responses), offsets=col(ds.offsets),
-                weights=col(ds.weights),
-                host_values=values, host_cols=cols, host_rows=rows,
-                feats=CSRFeatures(
-                    chunked_device_put(values), jnp.asarray(cols),
-                    jnp.asarray(rows), rb, int(d)),
-            )
+                e = CachedShard(
+                    index=len(entries), n_rows=ds.num_rows,
+                    nnz=int(mat.nnz), rows_bucket=rb, nnz_bucket=nb,
+                    row_offset=n_rows,
+                    labels=col(ds.responses), offsets=col(ds.offsets),
+                    weights=col(ds.weights),
+                    host_values=values, host_cols=cols, host_rows=rows,
+                    feats=CSRFeatures(
+                        chunked_device_put(values), jnp.asarray(cols),
+                        jnp.asarray(rows), rb, int(d)),
+                )
             entries.append(e)
             n_rows += ds.num_rows
             device_bytes += e.feature_bytes
@@ -345,6 +360,7 @@ class DeviceShardCache:
                         victim.feats = None
                         device_bytes -= victim.feature_bytes
                         evictions += 1
+                        _M_EVICTIONS.inc()
         if not entries:
             raise ValueError("stream yielded no rows to cache")
         cache = cls(entries, n_rows, int(d), dtype,
@@ -357,6 +373,10 @@ class DeviceShardCache:
             # The final block stayed pinned during ingest; settle to the
             # budget with the replay-aware policy (next use = shard 0).
             cache._enforce_budget(pinned=-1)
+        # Mirror residency gauges even when nothing evicts (a fully
+        # resident cache must not report 0 bytes in the registry).
+        _G_DEVICE_BYTES.set(cache.device_bytes)
+        _G_PEAK_BYTES.set(cache.peak_device_bytes)
         return cache
 
     # -- residency management ----------------------------------------------
@@ -393,6 +413,8 @@ class DeviceShardCache:
             victim.feats = None
             self.device_bytes -= victim.feature_bytes
             self._stats["evictions"] += 1
+            _M_EVICTIONS.inc()
+        _G_DEVICE_BYTES.set(self.device_bytes)
 
     def ensure(self, index: int) -> ResidentBlock:
         """Return a resident snapshot of the block, re-uploading the
@@ -408,16 +430,21 @@ class DeviceShardCache:
                     "(cache built without an hbm budget)")
             self._stats["misses"] += 1
             self._stats["bytes_reuploaded"] += e.feature_bytes
+            _M_MISSES.inc()
+            _M_REUPLOAD_BYTES.inc(e.feature_bytes)
             self.device_bytes += e.feature_bytes
             self.peak_device_bytes = max(self.peak_device_bytes,
                                          self.device_bytes)
-            e.feats = CSRFeatures(
-                chunked_device_put(e.host_values),
-                jnp.asarray(e.host_cols), jnp.asarray(e.host_rows),
-                e.rows_bucket, self.n_features)
+            _G_PEAK_BYTES.set(self.peak_device_bytes)
+            with span("shard_reupload"):
+                e.feats = CSRFeatures(
+                    chunked_device_put(e.host_values),
+                    jnp.asarray(e.host_cols), jnp.asarray(e.host_rows),
+                    e.rows_bucket, self.n_features)
             self._enforce_budget(pinned=index)
         else:
             self._stats["hits"] += 1
+            _M_HITS.inc()
         return ResidentBlock(index=e.index, n_rows=e.n_rows, feats=e.feats,
                              labels=e.labels, offsets=e.offsets,
                              weights=e.weights)
@@ -429,6 +456,7 @@ class DeviceShardCache:
         (`HostPrefetcher`), so H2D of shard k+1 overlaps the consumer's
         accumulate of shard k; resident epochs yield straight from HBM."""
         self._stats["epochs"] += 1
+        _M_EPOCHS.inc()
         depth = (self.prefetch_depth if prefetch_depth is None
                  else max(0, int(prefetch_depth)))
 

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
+from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.utils.tracing_guard import TracingGuard
 
 Array = jax.Array
@@ -207,12 +208,18 @@ class ShardedGLMObjective:
         device row-space state), the objective value, and the gradient."""
         z_list: List[Array] = []
         acc = None
-        for e in self.cache.blocks():
-            z, val, g_raw, su = self._k_init(
-                e.feats, e.labels, e.offsets, e.weights, coef,
-                n=e.n_rows)
-            z_list.append(z)
-            acc = self._fold(acc, (val, g_raw, su))
+        # The ``accumulate`` span covers the whole host-driven fold:
+        # kernel dispatch is async, so its self-time is enqueue +
+        # whatever the cache makes it wait for (shard_reupload /
+        # prefetch_wait nest inside). Spans stay OUTSIDE the jitted
+        # kernels (telemetry-in-trace rule).
+        with span("accumulate"):
+            for e in self.cache.blocks():
+                z, val, g_raw, su = self._k_init(
+                    e.feats, e.labels, e.offsets, e.weights, coef,
+                    n=e.n_rows)
+                z_list.append(z)
+                acc = self._fold(acc, (val, g_raw, su))
         val, g_raw, su = acc
         f = val + 0.5 * l2 * jnp.vdot(coef, coef)
         return z_list, f, self._finish_grad(g_raw, su, coef, l2)
@@ -223,9 +230,10 @@ class ShardedGLMObjective:
 
     def margin_direction_list(self, direction: Array) -> List[Array]:
         """Per-shard directional margins (one feature pass)."""
-        return [self._k_dir(e.feats, e.labels, e.offsets, e.weights,
-                            direction)
-                for e in self.cache.blocks()]
+        with span("accumulate"):
+            return [self._k_dir(e.feats, e.labels, e.offsets, e.weights,
+                                direction)
+                    for e in self.cache.blocks()]
 
     def trial_values(self, z_list: Sequence[Array],
                      zp_list: Sequence[Array], ts: Array,
@@ -243,10 +251,11 @@ class ShardedGLMObjective:
                                z_list: Sequence[Array], l2) -> Array:
         """Gradient given cached margins: one rmatvec pass."""
         acc = None
-        blocks = self.cache.blocks()
-        for e, z in zip(blocks, z_list):
-            acc = self._fold(acc, self._k_grad(e.feats, e.labels,
-                                               e.weights, z, n=e.n_rows))
+        with span("accumulate"):
+            blocks = self.cache.blocks()
+            for e, z in zip(blocks, z_list):
+                acc = self._fold(acc, self._k_grad(
+                    e.feats, e.labels, e.weights, z, n=e.n_rows))
         g_raw, su = acc
         return self._finish_grad(g_raw, su, coef, l2)
 
@@ -262,10 +271,11 @@ class ShardedGLMObjective:
         per shard (the streaming form of
         GLMObjective.hessian_vector_from_margins)."""
         acc = None
-        blocks = self.cache.blocks()
-        for e, d2 in zip(blocks, d2_list):
-            acc = self._fold(acc, self._k_hvp(
-                e.feats, e.labels, e.offsets, e.weights, d2, vec,
-                n=e.n_rows))
+        with span("accumulate"):
+            blocks = self.cache.blocks()
+            for e, d2 in zip(blocks, d2_list):
+                acc = self._fold(acc, self._k_hvp(
+                    e.feats, e.labels, e.offsets, e.weights, d2, vec,
+                    n=e.n_rows))
         r_raw, su = acc
         return self._finish_grad(r_raw, su, vec, l2)

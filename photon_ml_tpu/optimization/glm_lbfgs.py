@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.optimization.convergence import (
     ConvergenceReason,
@@ -46,6 +47,15 @@ from photon_ml_tpu.optimization.lbfgs import (
 )
 
 Array = jax.Array
+
+# Per-OUTER-iteration wall time of the host-driven streaming solvers
+# (L-BFGS here, TRON in tron.py) — each iteration is a fixed number of
+# feature passes over the shard cache, so this histogram is the
+# end-to-end cost of one streamed epoch-pair (no-op while telemetry is
+# off; the fused lax.while_loop solvers are NOT instrumented — spans
+# never open inside jitted code).
+_H_ITERATION = telemetry.histogram("training.iteration_seconds")
+_M_ITERATIONS = telemetry.counter("training.solver_iterations")
 
 
 class _State(NamedTuple):
@@ -325,68 +335,74 @@ def minimize_lbfgs_glm_streaming(
               else ConvergenceReason.NOT_CONVERGED)
     it = 0
     while reason == ConvergenceReason.NOT_CONVERGED:
-        direction, xx, xp, pp, gp = _stream_direction(g, hist, x)
-        zp_list = sobj.margin_direction_list(direction)
+        # ``solver_step`` = one outer iteration (direction + line search
+        # + accepted gradient), the per-iteration telemetry the fused
+        # impl cannot expose from inside its lax.while_loop.
+        with telemetry.timed_span("solver_step", histogram=_H_ITERATION,
+                                  counter=_M_ITERATIONS):
+            direction, xx, xp, pp, gp = _stream_direction(g, hist, x)
+            zp_list = sobj.margin_direction_list(direction)
 
-        first = int(hist.count) == 0  # mirrors st.hist.count == 0
-        ts, thresholds = _stream_candidates(
-            jnp.asarray(first), pp, f, gp, n_batched,
-            jnp.asarray(c1, dtype))
-        f_trials = sobj.trial_values(z_list, zp_list, ts,
-                                     _stream_coef_sq(xx, xp, pp, ts), l2)
-        ft_host = np.asarray(f_trials)
-        armijo = np.logical_and(ft_host <= np.asarray(thresholds),
-                                np.isfinite(ft_host))
-        ok = bool(armijo.any())
-        idx = int(np.argmax(armijo))  # first True
-        t_acc = ts[idx]
-        f_new = f_trials[idx]
+            first = int(hist.count) == 0  # mirrors st.hist.count == 0
+            ts, thresholds = _stream_candidates(
+                jnp.asarray(first), pp, f, gp, n_batched,
+                jnp.asarray(c1, dtype))
+            f_trials = sobj.trial_values(
+                z_list, zp_list, ts, _stream_coef_sq(xx, xp, pp, ts), l2)
+            ft_host = np.asarray(f_trials)
+            armijo = np.logical_and(ft_host <= np.asarray(thresholds),
+                                    np.isfinite(ft_host))
+            ok = bool(armijo.any())
+            idx = int(np.argmax(armijo))  # first True
+            t_acc = ts[idx]
+            f_new = f_trials[idx]
 
-        k = n_batched
-        t_tail = ts[-1]
-        while not ok and k < max_line_search + 1:
-            # Sequential tail past the batched block — rare (shrink^8).
-            t_tail = t_tail * shrink
-            f_t = sobj.trial_values(
-                z_list, zp_list, t_tail[None],
-                _stream_coef_sq(xx, xp, pp, t_tail[None]), l2)[0]
-            f_t_h = host(f_t)
-            thr = host(f + jnp.asarray(c1, dtype) * t_tail * gp)
-            if f_t_h <= thr and np.isfinite(f_t_h):
-                ok, t_acc, f_new = True, t_tail, f_t
+            k = n_batched
+            t_tail = ts[-1]
+            while not ok and k < max_line_search + 1:
+                # Sequential tail past the batched block — rare
+                # (shrink^8).
+                t_tail = t_tail * shrink
+                f_t = sobj.trial_values(
+                    z_list, zp_list, t_tail[None],
+                    _stream_coef_sq(xx, xp, pp, t_tail[None]), l2)[0]
+                f_t_h = host(f_t)
+                thr = host(f + jnp.asarray(c1, dtype) * t_tail * gp)
+                if f_t_h <= thr and np.isfinite(f_t_h):
+                    ok, t_acc, f_new = True, t_tail, f_t
+                    break
+                k += 1
+
+            it += 1  # the fused impl counts failed-line-search steps too
+            if not ok:
+                reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+                if it <= max_iter:
+                    value_hist[it], gnorm_hist[it] = f_h, gnorm
+                    if coef_hist is not None:
+                        coef_hist[it] = np.asarray(x)
                 break
-            k += 1
 
-        it += 1  # the fused impl counts failed-line-search steps too
-        if not ok:
-            reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
-            if it <= max_iter:
-                value_hist[it], gnorm_hist[it] = f_h, gnorm
-                if coef_hist is not None:
-                    coef_hist[it] = np.asarray(x)
-            break
+            x_new = _stream_axpy(x, t_acc, direction)
+            z_new = [_stream_axpy(z, t_acc, zp)
+                     for z, zp in zip(z_list, zp_list)]
+            g_new = sobj.grad_from_margins_list(x_new, z_new, l2)
+            hist = _stream_update_history(hist, x_new, x, g_new, g)
 
-        x_new = _stream_axpy(x, t_acc, direction)
-        z_new = [_stream_axpy(z, t_acc, zp)
-                 for z, zp in zip(z_list, zp_list)]
-        g_new = sobj.grad_from_margins_list(x_new, z_new, l2)
-        hist = _stream_update_history(hist, x_new, x, g_new, g)
+            gnorm_new = host(jnp.linalg.norm(g_new))
+            f_new_h = host(f_new)
+            f_delta = np.abs(f_h - f_new_h)
+            x, z_list, f, g = x_new, z_new, f_new, g_new
+            f_h, gnorm = f_new_h, gnorm_new
+            value_hist[it], gnorm_hist[it] = f_h, gnorm
+            if coef_hist is not None:
+                coef_hist[it] = np.asarray(x)
 
-        gnorm_new = host(jnp.linalg.norm(g_new))
-        f_new_h = host(f_new)
-        f_delta = np.abs(f_h - f_new_h)
-        x, z_list, f, g = x_new, z_new, f_new, g_new
-        f_h, gnorm = f_new_h, gnorm_new
-        value_hist[it], gnorm_hist[it] = f_h, gnorm
-        if coef_hist is not None:
-            coef_hist[it] = np.asarray(x)
-
-        if gnorm_new <= tol_s * gnorm0:
-            reason = ConvergenceReason.GRADIENT_CONVERGED
-        elif f_delta <= tol_s * f0_scale:
-            reason = ConvergenceReason.FUNCTION_VALUES_CONVERGED
-        elif it >= max_iter:
-            reason = ConvergenceReason.MAX_ITERATIONS
+            if gnorm_new <= tol_s * gnorm0:
+                reason = ConvergenceReason.GRADIENT_CONVERGED
+            elif f_delta <= tol_s * f0_scale:
+                reason = ConvergenceReason.FUNCTION_VALUES_CONVERGED
+            elif it >= max_iter:
+                reason = ConvergenceReason.MAX_ITERATIONS
 
     return OptimizerResult(
         x=x, value=f, grad_norm=jnp.asarray(gnorm, dtype),

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.optimization.convergence import (
     ConvergenceReason,
     OptimizerResult,
@@ -28,6 +29,11 @@ from photon_ml_tpu.optimization.convergence import (
 from photon_ml_tpu.optimization.lbfgs import _project
 
 Array = jax.Array
+
+# Shared per-outer-iteration telemetry with the streaming L-BFGS
+# (optimization/glm_lbfgs.py) — one histogram, one schema.
+_H_ITERATION = telemetry.histogram("training.iteration_seconds")
+_M_ITERATIONS = telemetry.counter("training.solver_iterations")
 
 _ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
 _SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
@@ -395,51 +401,56 @@ def minimize_tron_streaming(
     fails = 0
     first = True
     while reason == ConvergenceReason.NOT_CONVERGED:
-        d2_list = sobj.curvature_list(z_list)
+        # ``solver_step`` = one trust-region outer iteration (curvature +
+        # inner CG + trial evaluation) — same per-iteration telemetry
+        # schema as the streaming L-BFGS.
+        with telemetry.timed_span("solver_step", histogram=_H_ITERATION,
+                                  counter=_M_ITERATIONS):
+            d2_list = sobj.curvature_list(z_list)
 
-        # -- truncated CG (streamed Hv per step) --------------------------
-        s = jnp.zeros_like(g)
-        r = -g
-        d_vec = -g
-        rtr = jnp.vdot(r, r)
-        stop_norm = _CG_XI * jnp.linalg.norm(g)
-        cg_done = bool(host(jnp.linalg.norm(r) <= stop_norm))
-        k = 0
-        while not cg_done and k < max_cg:
-            hd = sobj.hessian_vector(d_vec, d2_list, l2)
-            s, r, d_vec, rtr, done_dev = _stream_cg_step(
-                s, r, d_vec, rtr, hd, delta, stop_norm)
-            cg_done = bool(host(done_dev))
-            k += 1
+            # -- truncated CG (streamed Hv per step) ----------------------
+            s = jnp.zeros_like(g)
+            r = -g
+            d_vec = -g
+            rtr = jnp.vdot(r, r)
+            stop_norm = _CG_XI * jnp.linalg.norm(g)
+            cg_done = bool(host(jnp.linalg.norm(r) <= stop_norm))
+            k = 0
+            while not cg_done and k < max_cg:
+                hd = sobj.hessian_vector(d_vec, d2_list, l2)
+                s, r, d_vec, rtr, done_dev = _stream_cg_step(
+                    s, r, d_vec, rtr, hd, delta, stop_norm)
+                cg_done = bool(host(done_dev))
+                k += 1
 
-        x_try = x + s
-        z_try, f_new, g_new = sobj.margins_value_grad(x_try, l2)
-        delta, accept_dev = _stream_tr_update(
-            f, f_new, g, s, r, delta, jnp.asarray(first))
-        first = False
-        accept = bool(host(accept_dev))
+            x_try = x + s
+            z_try, f_new, g_new = sobj.margins_value_grad(x_try, l2)
+            delta, accept_dev = _stream_tr_update(
+                f, f_new, g, s, r, delta, jnp.asarray(first))
+            first = False
+            accept = bool(host(accept_dev))
 
-        if accept:
-            it += 1
-            fails = 0
-            x, z_list, g = x_try, z_try, g_new
-            f_new_h = host(f_new)
-            f_delta = np.abs(f_h - f_new_h)
-            f, f_h = f_new, f_new_h
-            gnorm = host(jnp.linalg.norm(g))
-            value_hist[it], gnorm_hist[it] = f_h, gnorm
-            if coef_hist is not None:
-                coef_hist[it] = np.asarray(x)
-            if gnorm <= tol_s * gnorm0:
-                reason = ConvergenceReason.GRADIENT_CONVERGED
-            elif f_delta <= tol_s * f0_scale:
-                reason = ConvergenceReason.FUNCTION_VALUES_CONVERGED
-            elif it >= max_iter:
-                reason = ConvergenceReason.MAX_ITERATIONS
-        else:
-            fails += 1
-            if fails > max_improvement_failures:
-                reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+            if accept:
+                it += 1
+                fails = 0
+                x, z_list, g = x_try, z_try, g_new
+                f_new_h = host(f_new)
+                f_delta = np.abs(f_h - f_new_h)
+                f, f_h = f_new, f_new_h
+                gnorm = host(jnp.linalg.norm(g))
+                value_hist[it], gnorm_hist[it] = f_h, gnorm
+                if coef_hist is not None:
+                    coef_hist[it] = np.asarray(x)
+                if gnorm <= tol_s * gnorm0:
+                    reason = ConvergenceReason.GRADIENT_CONVERGED
+                elif f_delta <= tol_s * f0_scale:
+                    reason = ConvergenceReason.FUNCTION_VALUES_CONVERGED
+                elif it >= max_iter:
+                    reason = ConvergenceReason.MAX_ITERATIONS
+            else:
+                fails += 1
+                if fails > max_improvement_failures:
+                    reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
 
     return OptimizerResult(
         x=x, value=f, grad_norm=jnp.asarray(gnorm, dtype),

@@ -31,6 +31,7 @@ real row count before they leave the engine.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
@@ -39,7 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.device_feed import InFlightWindow, chunked_device_put
+from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.models.fixed_effect import FixedEffectModel
 from photon_ml_tpu.models.game_model import GameModel
 from photon_ml_tpu.models.matrix_factorization import MatrixFactorizationModel
@@ -51,6 +54,16 @@ from photon_ml_tpu.utils.tracing_guard import TracingGuard
 from photon_ml_tpu.utils.vocab import SortedVocab
 
 Array = jax.Array
+
+# Process-wide registry mirrors of the per-engine ``_stats`` (no-ops
+# while telemetry is off; sums across engines when several are live —
+# per-engine numbers stay on ``stats()``). The request-latency histogram
+# is what ROADMAP item 2's P50/P99 SLO telemetry reads.
+_M_REQUESTS = telemetry.counter("serving.requests")
+_M_DISPATCHES = telemetry.counter("serving.dispatches")
+_M_ROWS_SCORED = telemetry.counter("serving.rows_scored")
+_H_REQUEST_LATENCY = telemetry.histogram(
+    "serving.request_latency_seconds")
 
 
 class ExecutableCache:
@@ -299,6 +312,8 @@ class StreamingGameScorer:
         splits = np.cumsum([r.n_rows for r in group])[:-1]
         self._stats["requests"] += len(group)
         self._stats["rows_scored"] += n_total
+        _M_REQUESTS.inc(len(group))
+        _M_ROWS_SCORED.inc(n_total)
         self._stats["rows_padded"] += rows_b
         self._stats["nnz_scored"] += nnz_total
         self._stats["nnz_padded"] += sum(nnz_buckets)
@@ -333,13 +348,17 @@ class StreamingGameScorer:
 
     def _dispatch(self, key, host_args) -> Array:
         """Upload one padded batch and launch its bucket executable
-        (async — the returned device array is a future)."""
-        fn = self.cache.get_or_build(
-            key, lambda: self._build_fn(*key[0]))
-        dev = jax.tree.map(lambda a: chunked_device_put(a), host_args,
-                           is_leaf=lambda x: isinstance(x, np.ndarray))
-        self._stats["dispatches"] += 1
-        return fn(*dev, self._params)
+        (async — the returned device array is a future; the ``dispatch``
+        span measures upload + enqueue, and the device time surfaces as
+        ``device_wait`` where the InFlightWindow later blocks)."""
+        with span("dispatch"):
+            fn = self.cache.get_or_build(
+                key, lambda: self._build_fn(*key[0]))
+            dev = jax.tree.map(lambda a: chunked_device_put(a), host_args,
+                               is_leaf=lambda x: isinstance(x, np.ndarray))
+            self._stats["dispatches"] += 1
+            _M_DISPATCHES.inc()
+            return fn(*dev, self._params)
 
     # -- public scoring API ------------------------------------------------
 
@@ -385,22 +404,29 @@ class StreamingGameScorer:
         win = InFlightWindow(self.pipeline_depth)
 
         def settle(done):
-            out, idxs, splits = done
+            out, idxs, splits, t_start = done
             host = np.asarray(out)
+            # One shared dispatch: every request in the group waited the
+            # same wall time from featureization to settled result.
+            lat = time.perf_counter() - t_start
             for idx, chunk in zip(idxs, np.split(
                     host[:sum(datasets[i].num_rows for i in idxs)],
                     splits)):
                 results[idx] = chunk
+                _H_REQUEST_LATENCY.observe(lat)
 
         for g in groups:
             if len(g) == 1 and datasets[g[0]].num_rows \
                     > self.ladder.max_rows:
                 results[g[0]] = self.score(datasets[g[0]])
                 continue
-            reqs = [self._featureize(datasets[i]) for i in g]
-            key, args, splits = self._assemble(reqs)
+            t_start = time.perf_counter()
+            with span("featureize"):
+                reqs = [self._featureize(datasets[i]) for i in g]
+            with span("assemble"):
+                key, args, splits = self._assemble(reqs)
             out = self._dispatch(key, args)
-            done = win.push((out, g, splits), ready=out)
+            done = win.push((out, g, splits, t_start), ready=out)
             if done is not None:
                 settle(done)
         for done in win.drain():
@@ -417,16 +443,18 @@ class StreamingGameScorer:
         pending: List[np.ndarray] = []
 
         def settle(done):
-            out, n_real, last = done
+            out, n_real, t_start = done
             pending.append(np.asarray(out)[:n_real])
-            if not last:
+            if t_start is None:  # not the dataset's last piece
                 return None
+            _H_REQUEST_LATENCY.observe(time.perf_counter() - t_start)
             res = (pending[0] if len(pending) == 1
                    else np.concatenate(pending))
             pending.clear()
             return res
 
         for ds in datasets:
+            t_req = time.perf_counter()
             if ds.num_rows == 0:
                 # Flush in-flight work so output order is preserved.
                 for done in win.drain():
@@ -437,10 +465,14 @@ class StreamingGameScorer:
                 continue
             pieces = self._split(ds)
             for pi, piece in enumerate(pieces):
-                key, args, _ = self._assemble([self._featureize(piece)])
+                with span("featureize"):
+                    req = self._featureize(piece)
+                with span("assemble"):
+                    key, args, _ = self._assemble([req])
                 out = self._dispatch(key, args)
                 done = win.push(
-                    (out, piece.num_rows, pi == len(pieces) - 1),
+                    (out, piece.num_rows,
+                     t_req if pi == len(pieces) - 1 else None),
                     ready=out)
                 if done is not None:
                     res = settle(done)
@@ -506,6 +538,10 @@ class StreamingGameScorer:
                 "bucket_shapes": sorted(k[0] for k in self.cache.keys())}
 
     def stats(self) -> dict:
+        """Engine telemetry, snake_case schema (docs/OBSERVABILITY.md).
+        ``request_latency_seconds`` reads the PROCESS-wide serving
+        histogram (populated only while telemetry is enabled; count 0 /
+        None percentiles otherwise)."""
         s = dict(self._stats)
         s["padding_waste_rows"] = (
             1.0 - s["rows_scored"] / s["rows_padded"]
@@ -514,4 +550,5 @@ class StreamingGameScorer:
             1.0 - s["nnz_scored"] / s["nnz_padded"]
             if s["nnz_padded"] else 0.0)
         s.update(self.cache_info())
+        s["request_latency_seconds"] = _H_REQUEST_LATENCY.snapshot()
         return s

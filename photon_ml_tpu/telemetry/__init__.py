@@ -1,0 +1,103 @@
+"""Unified telemetry layer: metrics registry + pipeline spans + Perfetto
+trace export (docs/OBSERVABILITY.md).
+
+Quick tour::
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry import span
+
+    telemetry.enable(trace=True)            # drivers only; default off
+    reqs = telemetry.counter("serving.requests")
+    lat = telemetry.histogram("serving.request_latency_seconds")
+    with span("decode"):                    # nestable, thread-aware
+        ...
+    lat.observe(0.0013); reqs.inc()
+    telemetry.snapshot()                    # snake_case metrics dict
+    telemetry.export_chrome_trace("trace.json")   # load in Perfetto
+
+Disabled (the default) every mutation and ``span()`` is a no-op fast
+path — one branch, zero allocation — so library code stays instrumented
+unconditionally. Spans must never open inside jitted code (enforced by
+the jaxlint ``telemetry-in-trace`` rule).
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.telemetry import registry as _registry_mod
+from photon_ml_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    disable,
+    enabled,
+    registry,
+)
+from photon_ml_tpu.telemetry.spans import (
+    Tracer,
+    attribution_summary,
+    export_chrome_trace,
+    span,
+    stage_attribution,
+    timed_span,
+    tracer,
+)
+
+
+def enable(trace: bool = False) -> None:
+    """Turn telemetry on for this process; ``trace=True`` additionally
+    records raw span events for Chrome-trace export (aggregation is
+    always on while enabled)."""
+    tracer().record_events = bool(trace)
+    _registry_mod.enable()
+
+
+def reset() -> None:
+    """Zero all metrics and drop recorded spans; re-binds the tracer's
+    main thread to the caller. Drivers call this at startup so a
+    process that runs several in sequence (tests) reports per-run
+    telemetry."""
+    registry().reset()
+    tracer().reset()
+
+
+def counter(name: str) -> Counter:
+    return registry().counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return registry().gauge(name)
+
+
+def histogram(name: str, buckets=None) -> Histogram:
+    return registry().histogram(name, buckets)
+
+
+def snapshot() -> dict:
+    return registry().snapshot()
+
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+    "attribution_summary",
+    "counter",
+    "disable",
+    "enable",
+    "enabled",
+    "export_chrome_trace",
+    "gauge",
+    "histogram",
+    "registry",
+    "reset",
+    "snapshot",
+    "span",
+    "stage_attribution",
+    "timed_span",
+    "tracer",
+]

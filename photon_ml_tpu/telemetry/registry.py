@@ -1,0 +1,297 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket latency
+histograms with interpolated P50/P95/P99.
+
+Every hot path in the system (serving engine, block-stream feeder, device
+shard cache, streaming solvers) had grown its own ad-hoc ``_stats`` dict
+with inconsistent keys and no latency distributions (the reference ships
+first-class trackers — ml/optimization/game/*Tracker.scala — but our
+streamed paths predated any shared sink). This registry is the ONE sink:
+components keep their per-instance dicts for local introspection and
+mirror into named registry metrics; drivers snapshot the registry into a
+consistent snake_case ``telemetry.metrics`` block in metrics.json.
+
+Telemetry is DISABLED by default: every mutation (``inc``/``set``/
+``observe``) first checks one module-global flag and returns — no lock,
+no allocation — so instrumented hot paths cost a function call + a
+branch when nobody is looking (measured and asserted in
+tests/test_telemetry.py; see docs/OBSERVABILITY.md for the budget). CLI
+drivers enable it for their process; libraries never toggle it.
+
+Metric names are dotted snake_case namespaces (``serving.requests``,
+``data.shard_cache.hits``); the snapshot schema is part of the
+metrics.json contract (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+# One switch for the whole telemetry layer (metrics AND spans — spans.py
+# imports this module's accessors). Mutations early-return when off.
+_enabled = False
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+#: Default latency buckets: geometric, 10 µs .. 100 s, 5 per decade —
+#: ~17% relative resolution, 36 buckets, covering a single bucket
+#: dispatch (~100 µs) through a full streamed epoch.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = tuple(
+    round(10.0 ** (-5 + k / 5.0), 10) for k in range(36))
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is a no-op while telemetry is off.
+    ``calls`` counts inc() invocations (not the summed value) — what the
+    bench's disabled-overhead estimate multiplies by the no-op cost."""
+
+    __slots__ = ("name", "_value", "_calls", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._calls = 0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: int = 1) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value += amount
+            self._calls += 1
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    @property
+    def calls(self) -> int:
+        return self._calls
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+            self._calls = 0
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (e.g. resident device bytes)."""
+
+    __slots__ = ("name", "_value", "_calls")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._calls = 0
+
+    def set(self, value) -> None:
+        if not _enabled:
+            return
+        self._value = float(value)
+        self._calls += 1
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    @property
+    def calls(self) -> int:
+        return self._calls
+
+    def reset(self) -> None:
+        self._value = 0.0
+        self._calls = 0
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated quantiles.
+
+    Buckets are upper-edge-inclusive (a sample equal to a boundary lands
+    in the bucket that boundary closes — Prometheus ``le`` semantics),
+    with implicit underflow/overflow buckets beyond the configured
+    boundaries. ``quantile(q)`` linearly interpolates inside the bucket
+    containing rank ``q * count`` and clamps to the observed [min, max]
+    — so a single-sample histogram returns that sample EXACTLY for every
+    q, and a histogram whose samples all share one value is exact too;
+    otherwise the error is bounded by the bucket width (~17% relative at
+    the default buckets). Empty histograms return None.
+    """
+
+    __slots__ = ("name", "_bounds", "_counts", "_count", "_sum", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name: str,
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        bounds = tuple(sorted(buckets if buckets is not None
+                              else DEFAULT_LATENCY_BUCKETS))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._bounds = bounds
+        # counts[i] covers (bounds[i-1], bounds[i]]; counts[len(bounds)]
+        # is the overflow bucket (bounds[-1], +inf).
+        self._counts = [0] * (len(bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, value) -> None:
+        if not _enabled:
+            return
+        v = float(value)
+        with self._lock:
+            i = bisect.bisect_left(self._bounds, v)
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return None
+            target = q * self._count  # rank in [0, count]
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                if cum + c >= target:
+                    lo = self._bounds[i - 1] if i > 0 else self._min
+                    hi = (self._bounds[i] if i < len(self._bounds)
+                          else self._max)
+                    frac = (target - cum) / c
+                    val = lo + frac * (hi - lo)
+                    return min(max(val, self._min), self._max)
+                cum += c
+            return self._max  # q == 1 with float round-off
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            count, total = self._count, self._sum
+            mn, mx = self._min, self._max
+        out = {"count": count, "sum": total,
+               "mean": (total / count if count else None),
+               "min": mn, "max": mx}
+        out.update(self.percentiles())
+        return out
+
+    def bucket_counts(self) -> Dict:
+        """(upper-edge -> count) including the +inf overflow bucket."""
+        with self._lock:
+            out = {b: c for b, c in zip(self._bounds, self._counts)}
+            out["+inf"] = self._counts[-1]
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self._bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._min = self._max = None
+
+
+class MetricsRegistry:
+    """Name -> metric store. ``counter``/``gauge``/``histogram`` are
+    get-or-create (so module-level handles and late lookups share the
+    same object); ``snapshot`` renders the whole registry as the plain
+    snake_case dict that lands in metrics.json / BENCH output."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter(name)
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge(name)
+            return m
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        with self._lock:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = Histogram(name, buckets)
+            return m
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: v.value for k, v in sorted(counters.items())},
+            "gauges": {k: v.value for k, v in sorted(gauges.items())},
+            "histograms": {k: v.snapshot()
+                           for k, v in sorted(histograms.items())},
+        }
+
+    def mutation_calls(self) -> int:
+        """Total inc()/set()/observe() invocations since the last reset
+        — the disabled fast path executes this many no-op calls, so the
+        bench multiplies it by the measured no-op cost to bound the
+        disabled-telemetry overhead."""
+        with self._lock:
+            return (sum(c.calls for c in self._counters.values())
+                    + sum(g.calls for g in self._gauges.values())
+                    + sum(h.count for h in self._histograms.values()))
+
+    def reset(self) -> None:
+        """Zero every metric (objects and handles stay valid)."""
+        with self._lock:
+            metrics = (list(self._counters.values())
+                       + list(self._gauges.values())
+                       + list(self._histograms.values()))
+        for m in metrics:
+            m.reset()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry instance."""
+    return _REGISTRY

@@ -16,6 +16,12 @@ from photon_ml_tpu.cli import (  # noqa: F401  (import check)
 )
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import read_container, write_container
+from photon_ml_tpu.utils.events import (
+    EventListener,
+    PhotonOptimizationLogEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
 
 
 def _write_glm_avro(path, rng, n=200, d=5, poisson=False, w=None):
@@ -646,6 +652,8 @@ def test_stream_train_streamed_validation_matches_one_shot(tmp_path, rng):
 
 
 def test_stream_train_rejects_random_effects(tmp_path, rng):
+    from photon_ml_tpu import telemetry
+
     train = tmp_path / "train"
     _write_game_avro(train, rng, n=40)
     with pytest.raises(ValueError, match="one fixed-effect"):
@@ -662,6 +670,153 @@ def test_stream_train_rejects_random_effects(tmp_path, rng):
             "perUser:10,1e-6,1.0,1.0,LBFGS,L2",
             "--updating-sequence", "fixed,perUser",
             "--stream-train"])
+    # A failed run must not leave the process-wide recorder armed.
+    assert not telemetry.enabled()
+
+
+class RecordingListener(EventListener):
+    """Registered BY NAME from the driver (utils/events.py reflective
+    registration). State goes through a file named by an env var —
+    importlib re-imports this module under its dotted name, so a
+    class-level list would live on a DIFFERENT class object than the
+    one pytest asserts on."""
+
+    def on_event(self, event):
+        import dataclasses
+        import os
+
+        with open(os.environ["PHOTON_TEST_EVENT_LOG"], "a") as f:
+            f.write(json.dumps({"type": type(event).__name__,
+                                **dataclasses.asdict(event)}) + "\n")
+
+
+def test_stream_train_emits_training_events(tmp_path, rng, monkeypatch):
+    """Satellite: --stream-train emits TrainingStart / per-λ
+    PhotonOptimizationLog / TrainingFinish through the EventEmitter
+    (listener registration existed; the streamed path never emitted)."""
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("PHOTON_TEST_EVENT_LOG", str(log))
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=90)
+    game_training_driver.run([
+        "--train-input-dirs", str(train),
+        "--output-dir", str(tmp_path / "out"),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:25,1e-7,1.0,1.0,LBFGS,L2|25,1e-7,0.1,1.0,LBFGS,L2",
+        "--updating-sequence", "fixed",
+        "--stream-train", "--batch-rows", "32",
+        "--job-name", "stream-events-job",
+        "--event-listeners", "tests.test_cli_drivers.RecordingListener",
+    ])
+    evs = [json.loads(line) for line in log.read_text().splitlines()]
+    assert evs[0]["type"] == TrainingStartEvent.__name__
+    assert evs[0]["job_name"] == "stream-events-job"
+    opt = [e for e in evs
+           if e["type"] == PhotonOptimizationLogEvent.__name__]
+    assert sorted(e["reg_weight"] for e in opt) == [0.1, 1.0]  # per λ
+    for e in opt:
+        assert e["iterations"] >= 1
+        assert np.isfinite(e["final_value"])
+        assert e["converged_reason"]
+    assert evs[-1]["type"] == TrainingFinishEvent.__name__
+    assert evs[-1]["job_name"] == "stream-events-job"
+    assert evs[-1]["duration_seconds"] > 0
+
+
+def test_stream_train_snake_schema_alias_and_trace(tmp_path, rng):
+    """Satellite + tentpole acceptance: the metrics.json stream block is
+    snake_case (``stream_train``) with the camelCase ``streamTrain``
+    alias one release behind; the run writes a Perfetto-loadable trace
+    and a telemetry block whose stage attribution explains >= 90% of the
+    end-to-end wall time, with solver-iteration timing from the
+    histogram."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=120)
+    trace_path = tmp_path / "trace.json"
+    summary = game_training_driver.run(
+        ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+            "--output-dir", str(tmp_path / "out"), "--stream-train",
+            "--batch-rows", "32", "--hbm-budget", "8K",
+            "--trace-out", str(trace_path)])
+
+    info = summary["stream_train"]
+    assert set(info) == {"mode", "batch_rows", "hbm_budget_bytes",
+                         "feeder", "cache", "trace_budgets",
+                         "trace_counts"}
+    legacy = summary["streamTrain"]
+    assert legacy["batchRows"] == info["batch_rows"] == 32
+    assert legacy["hbmBudgetBytes"] == info["hbm_budget_bytes"]
+    assert legacy["mode"] == info["mode"] == "spill"
+    assert legacy["traceBudgets"] == info["trace_budgets"]
+
+    tele = summary["telemetry"]
+    assert tele["attributed_wall_frac"] >= 0.9
+    assert tele["attributed_wall_seconds"] <= tele["wall_seconds"] * 1.01
+    att = tele["stage_attribution"]
+    for stage in ("driver", "build_index", "ingest", "solve", "finalize",
+                  "solver_step", "accumulate", "decode"):
+        assert stage in att, stage
+    m = tele["metrics"]
+    assert m["counters"]["training.solver_iterations"] >= 1
+    it_hist = m["histograms"]["training.iteration_seconds"]
+    assert it_hist["count"] >= 1 and it_hist["p50"] is not None
+    assert m["counters"]["data.shard_cache.evictions"] > 0
+
+    doc = json.loads(trace_path.read_text())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in xs}
+    assert {"ingest", "solve", "solver_step", "accumulate"} <= names
+    assert all(e["dur"] >= 0 for e in xs)
+    # The on-disk metrics.json carries the same telemetry block.
+    on_disk = json.loads((tmp_path / "out" / "metrics.json").read_text())
+    assert on_disk["stream_train"] == json.loads(json.dumps(info))
+    assert on_disk["telemetry"]["attributed_wall_frac"] >= 0.9
+
+
+def test_scoring_stream_trace_latency_and_schema(tmp_path, rng):
+    """Tentpole acceptance, serving side: --stream writes a
+    Perfetto-loadable trace, reports request-latency P50/P99 from the
+    histogram, carries snake_case key aliases, and its stage attribution
+    explains >= 90% of wall time."""
+    model_dir, valid = _train_small_game(tmp_path, rng)
+    trace_path = tmp_path / "trace.json"
+    out = tmp_path / "score-out"
+    summary = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(model_dir),
+        "--output-dir", str(out),
+        "--stream", "--batch-rows", "33",
+        "--trace-out", str(trace_path),
+    ])
+    # snake_case aliases ride beside the deprecated camelCase keys.
+    assert summary["num_rows"] == summary["numRows"] == 140
+    assert summary["num_batches"] == summary["numBatches"]
+    assert summary["batch_rows"] == summary["batchRows"] == 33
+    assert summary["scoring_path"] == summary["scoringPath"]
+    assert summary["total_seconds"] == summary["totalSeconds"]
+
+    lat = summary["engine"]["request_latency_seconds"]
+    assert lat["count"] >= summary["numBatches"]
+    assert lat["p50"] is not None and lat["p99"] is not None
+    assert 0 < lat["p50"] <= lat["p99"]
+
+    tele = summary["telemetry"]
+    assert tele["attributed_wall_frac"] >= 0.9
+    m = tele["metrics"]
+    assert m["counters"]["serving.rows_scored"] == 140
+    assert m["counters"]["serving.dispatches"] >= summary["numBatches"]
+
+    doc = json.loads(trace_path.read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"score", "decode", "featureize", "dispatch"} <= names
+    # decode ran on the prefetch thread: more than one trace track.
+    tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(tids) >= 2
+    on_disk = json.loads((out / "metrics.json").read_text())
+    assert on_disk["telemetry"]["metrics"]["counters"][
+        "serving.rows_scored"] == 140
 
 
 def test_multihost_initialize_noop_single_host():

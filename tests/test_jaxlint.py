@@ -478,9 +478,90 @@ def test_every_rule_has_an_id_and_doc():
 
     assert sorted(RULE_IDS) == sorted({
         "retrace-hazard", "host-sync", "dtype-drift",
-        "nondeterministic-pytree"})
+        "nondeterministic-pytree", "telemetry-in-trace"})
     for rule in ALL_RULES:
         assert rule.doc and rule.id
+
+
+# -- telemetry-in-trace ----------------------------------------------------
+
+def test_telemetry_in_trace_flags_span_inside_jit():
+    vs = analyze_sources({"photon_ml_tpu/ops/m.py": '''
+import jax
+from photon_ml_tpu.telemetry import span
+
+
+@jax.jit
+def f(x):
+    with span("decode"):
+        return x + 1
+'''})
+    assert rules_of(vs) == ["telemetry-in-trace"]
+    assert "span" in vs[0].message
+
+
+def test_telemetry_in_trace_flags_module_attr_and_mutation():
+    """telemetry.histogram(...) opened in traced code + .inc()/.observe()
+    mutations reached THROUGH a traced helper are all flagged."""
+    vs = analyze_sources({"photon_ml_tpu/serving/m.py": '''
+import jax
+from photon_ml_tpu import telemetry
+
+COUNTER = telemetry.counter("serving.rows")
+
+
+def helper(x):
+    COUNTER.inc()
+    return x
+
+
+@jax.jit
+def f(x):
+    h = telemetry.histogram("serving.lat")
+    h.observe(0.1)
+    return helper(x)
+'''})
+    assert sorted(rules_of(vs)) == ["telemetry-in-trace"] * 3
+
+
+def test_telemetry_in_trace_ignores_host_loops_and_foreign_span():
+    """False positives: instrumented HOST code (the adoption pattern —
+    span around the dispatch loop) is fine, and an unrelated local
+    function named `span` is not the telemetry one."""
+    vs = analyze_sources({"photon_ml_tpu/ops/m.py": '''
+import jax
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import span
+
+_H = telemetry.histogram("training.iteration_seconds")
+
+
+@jax.jit
+def kernel(x):
+    return x * 2
+
+
+def host_loop(xs):
+    out = []
+    with span("accumulate"):
+        for x in xs:
+            out.append(kernel(x))
+    _H.observe(1.0)
+    return out
+''',
+        "photon_ml_tpu/serving/n.py": '''
+import jax
+
+
+def span(n):
+    return n
+
+
+@jax.jit
+def f(x):
+    return x + span(1)
+'''})
+    assert vs == []
 
 
 # -- the actual tree is clean ----------------------------------------------
