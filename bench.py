@@ -1349,10 +1349,21 @@ def _stream_train_child(cfg: dict) -> None:
                                batch_rows=batch_rows, prefetch_depth=2)
 
     if mode == "spill":
+        import hashlib
+
+        mesh = None
+        devices = None
+        mesh_n = int(cfg.get("mesh_devices") or 0)
+        if mesh_n > 1:
+            from photon_ml_tpu.parallel import make_mesh, mesh_device_list
+
+            mesh = make_mesh(mesh_n)
+            devices = mesh_device_list(mesh)
         t0 = time.perf_counter()
         cache = DeviceShardCache.from_stream(
-            stream(), "global", hbm_budget_bytes=cfg["hbm_budget_bytes"])
-        sobj = ShardedGLMObjective(obj, cache)
+            stream(), "global", hbm_budget_bytes=cfg["hbm_budget_bytes"],
+            devices=devices)
+        sobj = ShardedGLMObjective(obj, cache, mesh=mesh)
         _, f, g = sobj.margins_value_grad(coef, l2)
         _sync((f, g))
         first_dt = time.perf_counter() - t0  # ingest + first accumulate
@@ -1369,6 +1380,12 @@ def _stream_train_child(cfg: dict) -> None:
             "trace_counts": sobj.guard.counts(),
             "trace_budgets": sobj.trace_budgets(),
             "compile_bound_ok": True,  # assert_trace_budget passed
+            "device_count": jax.device_count(),
+            "mesh_devices": mesh_n or None,
+            # cross-device-count identity check for the parent: the
+            # fold result's exact bits, independent of the mesh size
+            "grad_sha256": hashlib.sha256(
+                np.asarray(g).tobytes()).hexdigest(),
         })
     else:
         t0 = time.perf_counter()
@@ -1439,9 +1456,62 @@ def stream_training_bench():
             capture_output=True, text=True, timeout=3600, check=True)
         results[mode] = json.loads(out.stdout.strip().splitlines()[-1])
 
+    # Mesh sub-measurement: the spill solve folded over simulated
+    # device meshes {1, 2, 4} (each child's jax is FORCED to exactly N
+    # virtual CPU devices via XLA_FLAGS, the tests/conftest.py
+    # multi_device pattern). On this host all N virtual devices share
+    # cpu_cores physical core(s), so the curve is expected FLAT or
+    # slightly down (per-device dispatch + [d]-partial transfers are
+    # pure overhead without real chips) — recorded honestly, no
+    # speedup claimed; the win the mesh buys is on real multi-chip
+    # meshes plus the invariant the children verify here: the fold's
+    # gradient bits are IDENTICAL across device counts, and compile
+    # counts stay per-bucket (compile_bound_ok per mesh size).
+    from photon_ml_tpu.utils.virtual_devices import forced_cpu_device_env
+
+    mesh_curve = []
+    for mesh_n in (1, 2, 4):
+        cfg = {"mode": "spill", "path": path, "rows": rows,
+               "batch_rows": batch_rows, "hbm_budget_bytes": budget,
+               "mesh_devices": mesh_n}
+        env = forced_cpu_device_env(mesh_n, os.environ)
+        env["PHOTON_BENCH_STREAM_TRAIN_CHILD"] = json.dumps(cfg)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=3600, check=True)
+        child = json.loads(out.stdout.strip().splitlines()[-1])
+        mesh_curve.append({
+            "mesh_devices": mesh_n,
+            "device_count": child["device_count"],
+            "cached_iteration_rows_per_sec":
+                child["cached_iteration_rows_per_sec"],
+            "first_iteration_rows_per_sec":
+                child["first_iteration_rows_per_sec"],
+            "compile_bound_ok": child["compile_bound_ok"],
+            "grad_sha256": child["grad_sha256"],
+            "evictions": child["cache"]["evictions"],
+            "per_device_bytes": child["cache"]["per_device_bytes"],
+        })
+
     oneshot, resident, spill = (results["oneshot"], results["resident"],
                                 results["spill"])
+    mesh_extra = {
+        "curve": mesh_curve,
+        "identical_grad_across_device_counts": len(
+            {m["grad_sha256"] for m in mesh_curve}) == 1,
+        "compile_bound_ok_all_mesh_sizes": all(
+            m["compile_bound_ok"] for m in mesh_curve),
+        "note": "simulated N-device CPU meshes on ONE physical core "
+                "(cpu_cores recorded at top level): the rows/s curve "
+                "is honest single-core truth — flat-to-down, no "
+                "parallel win exists or is claimed here; the measured "
+                "claims are (1) the fold's gradient bits do not depend "
+                "on the device count (ordered shard-order combine) and "
+                "(2) per-kernel compiles stay bucket-bounded at every "
+                "mesh size (TracingGuard-asserted in each child)",
+    }
     return {
+        "mesh": mesh_extra,
         "oneshot": oneshot,
         "stream_resident": resident,
         "stream_spill": spill,

@@ -296,6 +296,13 @@ class StreamingFixedEffectCoordinate:
     constraints, normalization context, or down-sampling (< 1.0). Those
     configurations stream-train through the resident assembled path,
     which reuses the full one-shot machinery.
+
+    ``mesh`` (a 1-D `jax.sharding.Mesh`, `--mesh-devices`) activates the
+    device fold: the cache must be placed on the same devices
+    (`DeviceShardCache.from_stream(devices=...)`); per-shard partials
+    accumulate on their own device and combine in fixed shard order, so
+    the solved model is bit-identical for every mesh size
+    (ops/sharded_objective.py).
     """
 
     name: str
@@ -310,6 +317,7 @@ class StreamingFixedEffectCoordinate:
     # compiled accumulate kernel across grid points — the same
     # no-recompile contract as the resident solvers).
     sharded_objective: Optional[object] = None
+    mesh: Optional[object] = None  # 1-D jax.sharding.Mesh (device fold)
 
     def __post_init__(self):
         from photon_ml_tpu.optimization.config import OptimizerType
@@ -335,13 +343,24 @@ class StreamingFixedEffectCoordinate:
             if self.sharded_objective.cache is not self.cache:
                 raise ValueError(
                     "shared sharded_objective must wrap the same cache")
+            want = None
+            if self.mesh is not None:
+                from photon_ml_tpu.parallel import mesh_device_list
+
+                devs = mesh_device_list(self.mesh)
+                want = devs if len(devs) > 1 else None
+            if self.sharded_objective.devices != want:
+                raise ValueError(
+                    "shared sharded_objective must use the same mesh "
+                    f"(objective devices {self.sharded_objective.devices}, "
+                    f"coordinate mesh devices {want})")
             self._sharded = self.sharded_objective
             self._objective = self._sharded.objective
         else:
             self._objective = GLMObjective(loss_for_task(self.task_type))
             self._sharded = ShardedGLMObjective(
                 self._objective, self.cache,
-                tracing_guard=self.tracing_guard)
+                tracing_guard=self.tracing_guard, mesh=self.mesh)
             # Expose the built objective through the same field callers
             # pass it back in with (grid sweeps share compiled kernels).
             self.sharded_objective = self._sharded

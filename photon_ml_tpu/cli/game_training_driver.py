@@ -166,7 +166,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(e.g. 512M, 8G): furthest-next-use shards "
                         "spill to host column buffers and re-upload "
                         "overlapped with the accumulate. Selects the "
-                        "sharded streaming solve (L2 LBFGS/TRON only)")
+                        "sharded streaming solve (L2 LBFGS/TRON only). "
+                        "With --mesh-devices the budget is PER DEVICE")
+    p.add_argument("--mesh-devices", type=_positive_int, default=None,
+                   metavar="N",
+                   help="fold the --hbm-budget streaming solve over a "
+                        "1-D mesh of the first N devices: cached shards "
+                        "place round-robin (shard i on device i mod N), "
+                        "per-shard partials accumulate on their own "
+                        "device, and the fold combines in fixed shard "
+                        "order — the model is bit-identical for every "
+                        "N (docs/SCALE.md §Training memory envelope). "
+                        "Requires --stream-train; N > 1 additionally "
+                        "requires --hbm-budget. N=1 is exactly the "
+                        "single-device fold")
     p.add_argument("--feeder", choices=["auto", "native", "python"],
                    default="auto",
                    help="--stream-train decode path (see "
@@ -298,6 +311,17 @@ def _run_training(args, logger, task, emitter):
     evaluators = [build_evaluator(s.strip())
                   for s in (args.evaluators or "").split(",") if s.strip()]
 
+    if args.mesh_devices is not None and not args.stream_train:
+        raise ValueError(
+            "--mesh-devices applies to the --stream-train solve; pass "
+            "--stream-train (and --hbm-budget for a mesh of > 1 device)")
+    if args.mesh_devices is not None and args.mesh_devices > 1 \
+            and args.hbm_budget is None:
+        raise ValueError(
+            "--mesh-devices > 1 requires --hbm-budget: the device fold "
+            "runs over the sharded shard-cache solve (the resident "
+            "assembled path is a single fused device batch)")
+
     if args.stream_train:
         if re_data or fre_data or len(sequence) != 1 \
                 or sequence[0] not in fe_data:
@@ -392,21 +416,6 @@ def _run_training(args, logger, task, emitter):
             int(data.num_rows), None)
 
 
-_STREAM_INFO_LEGACY_KEYS = {
-    # snake_case canonical -> deprecated camelCase alias, kept one
-    # release behind (docs/OBSERVABILITY.md §Schema); the legacy
-    # ``streamTrain`` block is built from these.
-    "batch_rows": "batchRows",
-    "hbm_budget_bytes": "hbmBudgetBytes",
-    "trace_budgets": "traceBudgets",
-    "trace_counts": "traceCounts",
-}
-
-
-def _legacy_stream_info(info: dict) -> dict:
-    return {_STREAM_INFO_LEGACY_KEYS.get(k, k): v for k, v in info.items()}
-
-
 def _save_outputs(args, out_dir, logger, sequence, results,
                   best_configs, best_result, shard_maps) -> None:
     """Model + index-map save (the ``finalize`` phase) — shared by the
@@ -475,11 +484,10 @@ def _write_summary(args, out_dir, logger, task, sequence, t0, results,
         "total_seconds": wall,
     }
     if stream_info is not None:
-        # ``stream_train`` is the canonical snake_case schema;
-        # ``streamTrain`` is the deprecated camelCase alias, kept one
-        # release behind (docs/OBSERVABILITY.md §Schema).
+        # ``stream_train`` is the canonical snake_case schema; the
+        # deprecated camelCase ``streamTrain`` alias rode one release
+        # behind and is now removed (docs/OBSERVABILITY.md §Schema).
         summary["stream_train"] = stream_info
-        summary["streamTrain"] = _legacy_stream_info(stream_info)
     summary["telemetry"] = telemetry.attribution_summary(wall)
     if args.trace_out:
         telemetry.export_chrome_trace(args.trace_out)
@@ -536,7 +544,10 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
     - a DeviceShardCache + sharded streaming accumulate solve
       (--hbm-budget; replay-aware feature-block spill, deterministic
       partials — resident and eviction-forced runs write identical
-      bytes).
+      bytes), optionally folded over a --mesh-devices 1-D device mesh
+      (round-robin shard placement, per-device accumulate, fixed-order
+      combine — every mesh size writes the same model bytes; the HBM
+      budget binds per device).
 
     Validation (when requested) streams through the serving engine in
     both modes."""
@@ -608,25 +619,37 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "mode": "resident-assembled",
             "batch_rows": args.batch_rows,
             "hbm_budget_bytes": None,
+            "mesh_devices": args.mesh_devices,
             "feeder": {k: v for k, v in data.ingest_stats.items()},
             "cache": None,
         }
     else:
         # -- spill: sharded streaming accumulate over the device cache ----
-        logger.info("stream-train (spill, hbm budget %d bytes): caching "
-                    "%r from %s in %d-row shards", budget, shard,
-                    train_inputs, args.batch_rows)
+        mesh = None
+        devices = None
+        if args.mesh_devices is not None and args.mesh_devices > 1:
+            from photon_ml_tpu.parallel import make_mesh, mesh_device_list
+
+            mesh = make_mesh(args.mesh_devices)
+            devices = mesh_device_list(mesh)
+        logger.info("stream-train (spill, hbm budget %d bytes%s): caching "
+                    "%r from %s in %d-row shards", budget,
+                    (f" PER DEVICE x {len(devices)} mesh devices"
+                     if devices else ""), shard, train_inputs,
+                    args.batch_rows)
         with span("ingest"):
             cache = DeviceShardCache.from_stream(
                 make_stream(), shard, hbm_budget_bytes=budget,
-                prefetch_depth=max(0, args.prefetch_batches))
+                prefetch_depth=max(0, args.prefetch_batches),
+                devices=devices)
         results = []
         shared = None
         with span("solve"):
             for cfg in grid:
                 coord = StreamingFixedEffectCoordinate(
                     name=name, cache=cache, feature_shard_id=shard,
-                    task_type=task, config=cfg, sharded_objective=shared)
+                    task_type=task, config=cfg, sharded_objective=shared,
+                    mesh=mesh)
                 shared = coord.sharded_objective
                 t0 = _time.perf_counter()
                 model, trackers, obj_hist = None, [], []
@@ -645,6 +668,7 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "mode": "spill",
             "batch_rows": args.batch_rows,
             "hbm_budget_bytes": budget,
+            "mesh_devices": args.mesh_devices,
             "feeder": cache.ingest_stats,
             "cache": cache.stats(),
             "trace_budgets": shared.trace_budgets(),

@@ -32,6 +32,18 @@ rows land on device in one of two regimes —
   overlaps the accumulate of shard k (the same three-stage pipeline
   shape as streamed scoring).
 
+With ``devices`` (a 1-D mesh's device list, ``--mesh-devices``), blocks
+place ROUND-ROBIN over the devices — block i is committed to
+``devices[i % D]``, spill re-uploads return to the same device, and
+``hbm_budget_bytes`` becomes PER DEVICE (each device's resident feature
+bytes stay within the budget; total residency scales to D x budget).
+The block -> device assignment is a pure function of the block index,
+so the fixed shard order — and with it the fold's numeric contract —
+is untouched by placement (ops/sharded_objective.py combines partials
+in shard order regardless of which device computed them). A single
+device (or ``devices=None``) is EXACTLY the PR-5 single-pool cache,
+bit for bit.
+
 The reference's analog is treeAggregate over cached RDD partitions
 (`ValueAndGradientAggregator.scala:243-274`): no node ever holds the whole
 dataset, partials combine in a fixed deterministic order.
@@ -218,6 +230,8 @@ class CachedShard:
     host_cols: Optional[np.ndarray]  # i32[nnz_bucket]
     host_rows: Optional[np.ndarray]  # i32[nnz_bucket] (block-local)
     feats: Optional[CSRFeatures] = None  # None = spilled
+    device: object = None  # mesh placement; None = default device
+    slot: int = 0  # mesh slot (index % n_devices); 0 without a mesh
 
     @property
     def feature_bytes(self) -> int:
@@ -238,6 +252,7 @@ class ResidentBlock:
     labels: object
     offsets: object
     weights: object
+    slot: int = 0  # device slot the block (and its partials) live on
 
 
 class DeviceShardCache:
@@ -273,7 +288,8 @@ class DeviceShardCache:
                  n_features: int, dtype,
                  hbm_budget_bytes: Optional[int] = None,
                  prefetch_depth: int = 2,
-                 ingest_stats: Optional[dict] = None):
+                 ingest_stats: Optional[dict] = None,
+                 devices: Optional[List] = None):
         self._entries = entries
         self.n_rows = int(n_rows)
         self.n_features = int(n_features)
@@ -283,12 +299,26 @@ class DeviceShardCache:
         self.ingest_stats = dict(ingest_stats or {})
         self._stats = {"hits": 0, "misses": 0, "evictions": 0,
                        "bytes_reuploaded": 0, "epochs": 0}
-        self.device_bytes = sum(e.feature_bytes for e in entries
-                                if e.feats is not None)
+        # A 1-device "mesh" is the single-pool cache: `devices` is only
+        # recorded (and placement/budget split per device) for >= 2.
+        self.devices = (list(devices)
+                        if devices is not None and len(devices) > 1
+                        else None)
+        self.n_slots = len(self.devices) if self.devices else 1
+        self._slot_bytes = [0] * self.n_slots
+        for e in entries:
+            if e.feats is not None:
+                self._slot_bytes[e.slot] += e.feature_bytes
         self.peak_device_bytes = self.device_bytes
         if hbm_budget_bytes is None:
             for e in entries:
                 e.host_values = e.host_cols = e.host_rows = None
+
+    @property
+    def device_bytes(self) -> int:
+        """Cache-accounted feature bytes resident across ALL devices
+        (with a mesh the budget binds PER device — see stats())."""
+        return sum(self._slot_bytes)
 
     # -- construction ------------------------------------------------------
 
@@ -296,7 +326,8 @@ class DeviceShardCache:
     def from_stream(cls, stream, shard_id: str, dtype=np.float32,
                     hbm_budget_bytes: Optional[int] = None,
                     min_rows_bucket: int = 16,
-                    prefetch_depth: int = 2) -> "DeviceShardCache":
+                    prefetch_depth: int = 2,
+                    devices: Optional[List] = None) -> "DeviceShardCache":
         """Ingest pass: decode (prefetched, via the stream) -> pad to the
         bucket ladder -> upload. Decode of batch k+1 overlaps the H2D of
         batch k (device_put is async; the stream's prefetch thread keeps
@@ -305,14 +336,20 @@ class DeviceShardCache:
         ingested block spills first (its next use, at the start of the
         first replay epoch, is the furthest away), so ingest-peak device
         bytes stay O(budget + one block) and the resident set ends as a
-        stable PREFIX of the shard order."""
+        stable PREFIX of the shard order. ``devices`` (>= 2) places
+        block i on ``devices[i % D]`` and makes the budget (and the
+        evict-as-you-go accounting) per device."""
+        import jax
         import jax.numpy as jnp
 
+        devs = (list(devices)
+                if devices is not None and len(devices) > 1 else None)
+        n_slots = len(devs) if devs else 1
         entries: List[CachedShard] = []
         n_rows = 0
         d = None
         ladder = None
-        device_bytes = 0
+        slot_bytes = [0] * n_slots
         peak_bytes = 0
         evictions = 0
         for ds in stream:
@@ -326,6 +363,8 @@ class DeviceShardCache:
                     max_rows=next_pow2(ds.num_rows))
             rb = ladder.rows_bucket(ds.num_rows)
             nb = ladder.nnz_bucket(mat.nnz, rb)
+            slot = len(entries) % n_slots
+            dev = devs[slot] if devs else None
             with span("shard_upload"):
                 values, cols, rows = padded_csr_arrays(
                     mat, rb, nb, value_dtype=dtype)
@@ -333,7 +372,12 @@ class DeviceShardCache:
                 def col(x):
                     out = np.zeros(rb, dtype)
                     out[:ds.num_rows] = x
-                    return jnp.asarray(out)
+                    return (jnp.asarray(out) if dev is None
+                            else jax.device_put(out, dev))
+
+                def idx(x):
+                    return (jnp.asarray(x) if dev is None
+                            else jax.device_put(x, dev))
 
                 e = CachedShard(
                     index=len(entries), n_rows=ds.num_rows,
@@ -343,22 +387,24 @@ class DeviceShardCache:
                     weights=col(ds.weights),
                     host_values=values, host_cols=cols, host_rows=rows,
                     feats=CSRFeatures(
-                        chunked_device_put(values), jnp.asarray(cols),
-                        jnp.asarray(rows), rb, int(d)),
+                        chunked_device_put(values, device=dev), idx(cols),
+                        idx(rows), rb, int(d)),
+                    device=dev, slot=slot,
                 )
             entries.append(e)
             n_rows += ds.num_rows
-            device_bytes += e.feature_bytes
-            peak_bytes = max(peak_bytes, device_bytes)
+            slot_bytes[slot] += e.feature_bytes
+            peak_bytes = max(peak_bytes, sum(slot_bytes))
             if hbm_budget_bytes is not None:
-                # Evict-as-you-go: most-recent-first (keep the prefix),
-                # never the block just uploaded.
+                # Evict-as-you-go on the block's OWN device: the budget
+                # is per device, and eviction stays most-recent-first
+                # (keep the prefix), never the block just uploaded.
                 for victim in reversed(entries[:-1]):
-                    if device_bytes <= hbm_budget_bytes:
+                    if slot_bytes[slot] <= hbm_budget_bytes:
                         break
-                    if victim.feats is not None:
+                    if victim.slot == slot and victim.feats is not None:
                         victim.feats = None
-                        device_bytes -= victim.feature_bytes
+                        slot_bytes[slot] -= victim.feature_bytes
                         evictions += 1
                         _M_EVICTIONS.inc()
         if not entries:
@@ -366,7 +412,7 @@ class DeviceShardCache:
         cache = cls(entries, n_rows, int(d), dtype,
                     hbm_budget_bytes=hbm_budget_bytes,
                     prefetch_depth=prefetch_depth,
-                    ingest_stats=stream.stats())
+                    ingest_stats=stream.stats(), devices=devs)
         cache._stats["evictions"] += evictions
         cache.peak_device_bytes = max(cache.peak_device_bytes, peak_bytes)
         if hbm_budget_bytes is not None:
@@ -393,33 +439,43 @@ class DeviceShardCache:
         return {(e.rows_bucket, e.nnz_bucket) for e in self._entries}
 
     def _enforce_budget(self, pinned: int) -> None:
-        """Evict until within budget. Victim = resident block whose next
-        use is FURTHEST in the fixed cyclic replay order from the block
-        in hand (`pinned`; -1 = before an epoch, i.e. next use starts at
-        shard 0). Belady's rule for a known cyclic scan — see the class
-        docstring for why plain LRU is pathological here."""
+        """Evict until within budget — PER DEVICE slot under a mesh (the
+        budget bounds each device's residency; a single-pool cache is
+        the one-slot case). Victim = that slot's resident block whose
+        next use is FURTHEST in the fixed cyclic replay order from the
+        block in hand (`pinned`; -1 = before an epoch, i.e. next use
+        starts at shard 0). Belady's rule for a known cyclic scan — see
+        the class docstring for why plain LRU is pathological here.
+        Round-robin slots are index-arithmetic subsequences of the shard
+        order, so the GLOBAL cyclic distance ranks a slot's blocks
+        exactly as the slot's own replay cycle does."""
         budget = self.hbm_budget_bytes
         if budget is None:
             return
         n = len(self._entries)
         cur = pinned if pinned >= 0 else 0
-        resident = [e for e in self._entries
-                    if e.feats is not None and e.index != pinned]
-        # descending cyclic distance (j - cur) mod n: furthest-next-use
-        # first; ties impossible (indexes are unique).
-        resident.sort(key=lambda e: -((e.index - cur) % n))
-        while self.device_bytes > budget and resident:
-            victim = resident.pop(0)
-            victim.feats = None
-            self.device_bytes -= victim.feature_bytes
-            self._stats["evictions"] += 1
-            _M_EVICTIONS.inc()
+        for slot in range(self.n_slots):
+            if self._slot_bytes[slot] <= budget:
+                continue
+            resident = [e for e in self._entries
+                        if e.feats is not None and e.index != pinned
+                        and e.slot == slot]
+            # descending cyclic distance (j - cur) mod n: furthest-next-
+            # use first; ties impossible (indexes are unique).
+            resident.sort(key=lambda e: -((e.index - cur) % n))
+            while self._slot_bytes[slot] > budget and resident:
+                victim = resident.pop(0)
+                victim.feats = None
+                self._slot_bytes[slot] -= victim.feature_bytes
+                self._stats["evictions"] += 1
+                _M_EVICTIONS.inc()
         _G_DEVICE_BYTES.set(self.device_bytes)
 
     def ensure(self, index: int) -> ResidentBlock:
         """Return a resident snapshot of the block, re-uploading the
         spill buffers on a miss (async put — the caller overlaps it with
         whatever it is accumulating)."""
+        import jax
         import jax.numpy as jnp
 
         e = self._entries[index]
@@ -432,14 +488,20 @@ class DeviceShardCache:
             self._stats["bytes_reuploaded"] += e.feature_bytes
             _M_MISSES.inc()
             _M_REUPLOAD_BYTES.inc(e.feature_bytes)
-            self.device_bytes += e.feature_bytes
+            self._slot_bytes[e.slot] += e.feature_bytes
             self.peak_device_bytes = max(self.peak_device_bytes,
                                          self.device_bytes)
             _G_PEAK_BYTES.set(self.peak_device_bytes)
             with span("shard_reupload"):
+                # Spilled blocks return to their ASSIGNED device — the
+                # round-robin placement is part of the replay contract.
+                def idx(x):
+                    return (jnp.asarray(x) if e.device is None
+                            else jax.device_put(x, e.device))
+
                 e.feats = CSRFeatures(
-                    chunked_device_put(e.host_values),
-                    jnp.asarray(e.host_cols), jnp.asarray(e.host_rows),
+                    chunked_device_put(e.host_values, device=e.device),
+                    idx(e.host_cols), idx(e.host_rows),
                     e.rows_bucket, self.n_features)
             self._enforce_budget(pinned=index)
         else:
@@ -447,7 +509,7 @@ class DeviceShardCache:
             _M_HITS.inc()
         return ResidentBlock(index=e.index, n_rows=e.n_rows, feats=e.feats,
                              labels=e.labels, offsets=e.offsets,
-                             weights=e.weights)
+                             weights=e.weights, slot=e.slot)
 
     def blocks(self, prefetch_depth: Optional[int] = None
                ) -> Iterator[ResidentBlock]:
@@ -480,5 +542,13 @@ class DeviceShardCache:
             "peak_device_bytes": self.peak_device_bytes,
             "resident_shards": sum(1 for e in self._entries
                                    if e.feats is not None),
+            # Mesh placement: hbm_budget_bytes binds PER device, so the
+            # per-device breakdown is the budget-compliance view.
+            "mesh_devices": len(self.devices) if self.devices else None,
+            "per_device_bytes": list(self._slot_bytes),
+            "per_device_resident_shards": [
+                sum(1 for e in self._entries
+                    if e.feats is not None and e.slot == slot)
+                for slot in range(self.n_slots)],
         })
         return s

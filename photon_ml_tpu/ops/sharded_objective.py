@@ -1,5 +1,6 @@
 """Sharded GLM objective: full-batch (value, gradient, Hessian-vector)
-by accumulating per-shard partials over a device shard cache.
+by accumulating per-shard partials over a device shard cache — and,
+with a mesh, over the devices of a 1-D data mesh.
 
 The TPU out-of-core analog of the reference's treeAggregate objective
 evaluation (`ValueAndGradientAggregator.scala:243-274`,
@@ -7,6 +8,42 @@ evaluation (`ValueAndGradientAggregator.scala:243-274`,
 each `CachedShard` (data/shard_cache.py) contributes a partial through a
 per-bucket jitted accumulate kernel, and partials fold on device in FIXED
 shard order, so only the final scalar/vector leaves the device.
+
+**Mesh regime (`mesh=`).** Cache blocks place round-robin over the mesh
+devices (block i on device i % D, data/shard_cache.py `devices=`); each
+block's partial is computed BY ITS OWN DEVICE through that device's own
+kernel instance, so the feature passes — the expensive part — run D-wide
+in parallel, streaming rows out-of-core over time while the chip axis
+carries the per-shard compute (the 2-D devices x time regime of
+docs/SCALE.md §Training memory envelope; PAPERS.md "Large Scale
+Distributed Linear Algebra With TPUs", ALX's sharded tables). Row-space
+solver state (margins, curvature) stays resident on each block's device;
+only [d]-vectors cross the interconnect: the coefficient/direction
+broadcast out (D-1 puts per pass — the reference's per-evaluation
+coefficient broadcast), the per-shard partials back in.
+
+Cross-device combine (both are fixed-order reductions; neither ever
+depends on arrival timing):
+
+- ``combine="ordered"`` (default): partials transfer to the fold device
+  (mesh device 0) and left-fold in GLOBAL SHARD ORDER — the exact PR-5
+  association. Because a given executable is bitwise-deterministic on
+  every device of a homogeneous mesh (measured on virtual CPU devices;
+  same compiled program per chip on TPU), the result is **bit-identical
+  for every device count, including the non-mesh fold**: the
+  reassociation bound of the device axis is exactly zero. This is what
+  `--mesh-devices` uses and what the device-count-invariance tests pin.
+- ``combine="local"``: each device left-folds ITS OWN blocks in shard
+  order, then the D device partials left-fold in device order — the
+  depth-2 treeAggregate / psum shape (D-1 cross-device transfers per
+  pass instead of S - S/D). The result differs from "ordered" only by
+  reassociating the same S f32 addends into D round-robin groups:
+  |delta| <= (S-1) * eps * sum_i |p_i| (standard summation-error bound),
+  deterministic for fixed (S, D), and IDENTICAL to "ordered" at D = 1.
+
+A 1-device mesh (or ``mesh=None``) takes the single-device code path
+exactly — no committed placement, no transfers, today's fold bit for
+bit.
 
 Numeric contract (measured, not assumed — docs/SCALE.md §Training memory
 envelope): XLA's full-shape reductions are vectorized with
@@ -20,14 +57,18 @@ tested:
   `value_from_margins`/`gradient_from_margins` bit for bit (same arrays,
   same ops);
 - for any fixed shard decomposition, the accumulation is deterministic
-  and INDEPENDENT of cache residency: resident replay, spill/re-upload
-  replay, and prefetch depth all produce identical bits (re-uploaded
-  buffers are the evicted bytes; the fold order is the shard order).
+  and INDEPENDENT of cache residency AND device count (default
+  combine): resident replay, spill/re-upload replay, prefetch depth and
+  mesh size all produce identical bits (re-uploaded buffers are the
+  evicted bytes; the fold order is the shard order).
 
-Compile discipline: every kernel is built once per objective instance and
-registered with a `TracingGuard`; each kernel traces once per distinct
-bucket shape, so total compiles <= kernel_families x bucket_shapes —
-assertable, not hand-counted (`assert_trace_budget`).
+Compile discipline: every kernel — one instance PER MESH DEVICE, so each
+device's executables are its own — is built once per objective instance
+and registered with a `TracingGuard`; each instance traces once per
+distinct bucket shape IT SEES, so every registered kernel's budget is in
+bucket terms (compiles scale with bucket count, never with device
+count — a kernel on device k cannot retrace because other devices
+exist). Assertable, not hand-counted (`assert_trace_budget`).
 
 Normalization is supported by accumulating the RAW `X^T u` partials plus
 `sum(u)` and applying the factor/shift chain ONCE at the apex (the same
@@ -37,7 +78,8 @@ the two are bit-identical).
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,9 +90,69 @@ from photon_ml_tpu.utils.tracing_guard import TracingGuard
 
 Array = jax.Array
 
-#: Distinct jitted accumulate-kernel families an instance may build; each
-#: traces at most once per bucket shape (see assert_trace_budget).
-KERNEL_FAMILIES = 7
+#: Distinct jitted accumulate-kernel families a device kit may build;
+#: each traces at most once per bucket shape (see assert_trace_budget).
+KERNEL_FAMILIES = 8
+
+_NULL_SPAN = contextlib.nullcontext()
+
+
+class _Fold:
+    """One accumulation pass's combine. `add(slot, part)` consumes the
+    per-shard partials in fixed shard order; `result()` returns the
+    apex value. Subclasses implement the three combine strategies."""
+
+    def __init__(self, sobj: "ShardedGLMObjective"):
+        self.s = sobj
+        self.acc = None
+
+    def result(self):
+        return self.acc
+
+
+class _SingleFold(_Fold):
+    """mesh=None / 1 device: today's left-fold, bit for bit."""
+
+    def add(self, slot, part):
+        self.acc = part if self.acc is None \
+            else self.s._kits[0]["acc"](self.acc, part)
+
+
+class _OrderedFold(_Fold):
+    """Default mesh combine: transfer each partial to the fold device
+    and left-fold in GLOBAL shard order — the PR-5 association exactly,
+    so the result is bit-identical for every device count."""
+
+    def add(self, slot, part):
+        with span("cross_device_combine"):
+            part = jax.device_put(part, self.s.devices[0])
+            self.acc = part if self.acc is None \
+                else self.s._k_combine(self.acc, part)
+
+
+class _LocalFold(_Fold):
+    """psum-shape mesh combine: per-device left-folds (each on its own
+    device, in shard order), then a fixed device-order fold at the
+    apex — D-1 transfers per pass, bounded f32 reassociation vs
+    "ordered" (module docstring)."""
+
+    def __init__(self, sobj):
+        super().__init__(sobj)
+        self.accs = [None] * len(sobj.devices)
+
+    def add(self, slot, part):
+        self.accs[slot] = part if self.accs[slot] is None \
+            else self.s._kits[slot]["acc"](self.accs[slot], part)
+
+    def result(self):
+        acc = None
+        with span("cross_device_combine"):
+            for part in self.accs:
+                if part is None:
+                    continue
+                part = jax.device_put(part, self.s.devices[0])
+                acc = part if acc is None else self.s._k_combine(acc, part)
+        return acc
 
 
 class ShardedGLMObjective:
@@ -59,33 +161,101 @@ class ShardedGLMObjective:
     ``objective`` supplies the loss and (optional) normalization context;
     row-space solver state (margins, direction margins, curvature) lives
     as per-shard lists aligned with the cache's fixed shard order and is
-    always device-resident — the feature blocks are the only thing the
-    cache may spill, which keeps the margin-cached L-BFGS line search
-    feature-pass-free (optimization/glm_lbfgs.py).
+    always device-resident — on each shard's OWN mesh device — the
+    feature blocks are the only thing the cache may spill, which keeps
+    the margin-cached L-BFGS line search feature-pass-free
+    (optimization/glm_lbfgs.py).
+
+    ``mesh`` (a 1-D `jax.sharding.Mesh`, `parallel.make_mesh`) activates
+    the device fold: the cache must have been built with the same
+    devices (`DeviceShardCache.from_stream(devices=...)`). ``combine``
+    picks the cross-device reduction ("ordered" | "local", module
+    docstring).
     """
 
     def __init__(self, objective: GLMObjective, cache,
-                 tracing_guard: Optional[TracingGuard] = None):
+                 tracing_guard: Optional[TracingGuard] = None,
+                 mesh=None, combine: str = "ordered"):
         self.objective = objective
         self.cache = cache
         self.guard = tracing_guard if tracing_guard is not None \
             else TracingGuard()
-        obj = objective
+        if combine not in ("ordered", "local"):
+            raise ValueError(
+                f"combine must be 'ordered' or 'local', got {combine!r}")
+        self.combine = combine
 
-        # Kernels are built per INSTANCE (closures over the stable
-        # objective) so each instance's guard owns its trace counts; one
-        # kernel traces once per distinct (rows_bucket, nnz_bucket).
+        devices = None
+        if mesh is not None:
+            from photon_ml_tpu.parallel.distributed import mesh_device_list
 
-        # Row-space REDUCTIONS slice to the shard's true row count ``n``
-        # (a STATIC arg) before summing: XLA's vectorized reduce is not
-        # prefix-stable under zero-padding (tail-lane association depends
-        # on the reduced length), so summing wl[:n] — the same shape the
-        # one-shot path reduces — is what makes the single-shard partial
-        # bitwise-exact. A stream yields at most two distinct true row
-        # counts (batch_rows + the final partial), so the extra static
-        # arg at most doubles each family's compile count. The rmatvec
-        # scatter stays at the PADDED shape (pad entries contribute +0 to
-        # row 0/col 0; prefix stability is pinned by the bitwise tests).
+            devices = mesh_device_list(mesh)
+            if len(devices) <= 1:
+                # A 1-device mesh IS the single-device fold — same code
+                # path, same kernels, same bits as mesh=None.
+                devices = None
+        self.mesh = mesh if devices is not None else None
+        self.devices = devices
+        cache_devs = getattr(cache, "devices", None)
+        if devices is not None:
+            if cache_devs is None or list(cache_devs) != list(devices):
+                raise ValueError(
+                    "mesh-sharded objective needs a cache placed on the "
+                    f"same devices: mesh has {devices}, cache has "
+                    f"{cache_devs} — build the DeviceShardCache with "
+                    "devices=mesh_device_list(mesh)")
+        elif cache_devs is not None:
+            # The converse mis-wiring must fail just as loudly: a
+            # mesh-placed cache has blocks committed across devices and
+            # slots >= 1, which the single-device kernel kit cannot
+            # serve.
+            raise ValueError(
+                f"cache is placed on {len(cache_devs)} mesh devices but "
+                "the objective was built without a mesh — pass "
+                "mesh=make_mesh(len(cache.devices))")
+
+        # Kernels are built per INSTANCE and per MESH DEVICE (closures
+        # over the stable objective), so each device's executables — and
+        # their trace counts in the guard — are its own; one kernel
+        # traces once per distinct (rows_bucket, nnz_bucket) it sees.
+        self._tags = ([""] if devices is None
+                      else [f"@d{k}" for k in range(len(devices))])
+        self._kits = [self._build_kit(tag) for tag in self._tags]
+        if devices is not None:
+            # Apex combine kernel (fold device): partials arrive as
+            # committed transfers, one trace per partial STRUCTURE.
+            def combine_kernel(acc, part):
+                return jax.tree.map(jnp.add, acc, part)
+
+            self._k_combine = jax.jit(combine_kernel)
+            self.guard.track("sharded:combine", self._k_combine)
+        # Back-compat aliases (tests poke individual kernels).
+        kit0 = self._kits[0]
+        self._k_init = kit0["init"]
+        self._k_dir = kit0["dir"]
+        self._k_trial = kit0["trial"]
+        self._k_grad = kit0["grad"]
+        self._k_curv = kit0["curv"]
+        self._k_hvp = kit0["hvp"]
+        self._k_acc = kit0["acc"]
+
+    def _build_kit(self, tag: str) -> Dict[str, object]:
+        """One device's kernel kit. Bodies are IDENTICAL across devices
+        (and to the PR-5 single-device kernels); only the jit instance —
+        hence the executable cache and its guard entry — is per device.
+
+        Row-space REDUCTIONS slice to the shard's true row count ``n``
+        (a STATIC arg) before summing: XLA's vectorized reduce is not
+        prefix-stable under zero-padding (tail-lane association depends
+        on the reduced length), so summing wl[:n] — the same shape the
+        one-shot path reduces — is what makes the single-shard partial
+        bitwise-exact. A stream yields at most two distinct true row
+        counts (batch_rows + the final partial), so the extra static
+        arg at most doubles each family's compile count. The rmatvec
+        scatter stays at the PADDED shape (pad entries contribute +0 to
+        row 0/col 0; prefix stability is pinned by the bitwise tests).
+        """
+        obj = self.objective
 
         def init_kernel(feats, labels, offsets, weights, coef, n: int):
             """Margins + value partial + raw-gradient partial, one pass."""
@@ -124,18 +294,50 @@ class ShardedGLMObjective:
         def acc_kernel(acc, part):
             return jax.tree.map(jnp.add, acc, part)
 
-        self._k_init = jax.jit(init_kernel, static_argnames=("n",))
-        self._k_dir = jax.jit(direction_kernel)
-        self._k_trial = jax.jit(trial_kernel, static_argnames=("n",))
-        self._k_grad = jax.jit(grad_kernel, static_argnames=("n",))
-        self._k_curv = jax.jit(curvature_kernel)
-        self._k_hvp = jax.jit(hvp_kernel, static_argnames=("n",))
-        self._k_acc = jax.jit(acc_kernel)
-        for name, fn in [("init", self._k_init), ("dir", self._k_dir),
-                         ("trial", self._k_trial), ("grad", self._k_grad),
-                         ("curv", self._k_curv), ("hvp", self._k_hvp),
-                         ("acc", self._k_acc)]:
-            self.guard.track(f"sharded:{name}", fn)
+        def axpy_kernel(a, t, b):
+            """a + t*b — the accepted-step margin update of the
+            streaming L-BFGS, on the shard's own device."""
+            return a + t * b
+
+        kit = {
+            "init": jax.jit(init_kernel, static_argnames=("n",)),
+            "dir": jax.jit(direction_kernel),
+            "trial": jax.jit(trial_kernel, static_argnames=("n",)),
+            "grad": jax.jit(grad_kernel, static_argnames=("n",)),
+            "curv": jax.jit(curvature_kernel),
+            "hvp": jax.jit(hvp_kernel, static_argnames=("n",)),
+            "acc": jax.jit(acc_kernel),
+            "axpy": jax.jit(axpy_kernel),
+        }
+        for name, fn in kit.items():
+            self.guard.track(f"sharded:{name}{tag}", fn)
+        return kit
+
+    # -- mesh plumbing -----------------------------------------------------
+
+    def _per_device(self, x) -> List:
+        """Broadcast a [d]-vector (or [K] candidate block / scalar) to
+        every mesh device — the reference's coefficient broadcast, D-1
+        puts per pass. Without a mesh the value is used as-is."""
+        if self.devices is None:
+            return [x]
+        return [jax.device_put(x, d) for d in self.devices]
+
+    def _dev_span(self, slot: int):
+        """Per-device fold-stage span (mesh only): slices named per
+        device let Perfetto / stage attribution show each device-fold
+        stage on its own track row. The non-mesh path keeps PR-5's span
+        structure untouched."""
+        if self.devices is None:
+            return _NULL_SPAN
+        return span(f"device_fold:d{slot}")
+
+    def _new_fold(self) -> _Fold:
+        if self.devices is None:
+            return _SingleFold(self)
+        if self.combine == "ordered":
+            return _OrderedFold(self)
+        return _LocalFold(self)
 
     # -- introspection -----------------------------------------------------
 
@@ -147,30 +349,46 @@ class ShardedGLMObjective:
     def dim(self) -> int:
         return self.cache.n_features
 
+    def _slot_bucket_shapes(self, slot: int) -> set:
+        if self.devices is None:
+            return set(self.cache.bucket_shapes())
+        return {(e.rows_bucket, e.nnz_bucket)
+                for e in self.cache.entries if e.slot == slot}
+
     def trace_budgets(self) -> dict:
-        """Per-kernel compile budgets in terms of the cache's bucket
+        """Per-kernel compile budgets in terms of the bucket count of
+        the blocks EACH DEVICE actually holds — never of the device
         count: feature kernels trace once per (rows, nnz) bucket shape;
         the trial kernel additionally distinguishes the [K]-candidate
-        block from the [1]-candidate sequential tail; the tree
-        accumulator traces once per partial STRUCTURE (value-grad
-        triple, trial vector, hvp pair), independent of buckets."""
-        buckets = max(1, len(self.cache.bucket_shapes()))
-        row_buckets = max(1, len({b[0] for b in
-                                  self.cache.bucket_shapes()}))
-        return {
-            "sharded:init": 2 * buckets,
-            "sharded:dir": buckets,
-            "sharded:grad": 2 * buckets,
-            "sharded:hvp": 2 * buckets,
-            "sharded:trial": 4 * row_buckets,
-            "sharded:curv": row_buckets,
-            "sharded:acc": 4,
-        }
+        block from the [1]-candidate sequential tail; the margin-update
+        axpy traces per row bucket; the tree accumulators trace once per
+        partial STRUCTURE (value-grad triple, trial vector, hvp pair),
+        independent of buckets."""
+        budgets = {}
+        for slot, tag in enumerate(self._tags):
+            shapes = self._slot_bucket_shapes(slot)
+            buckets = max(1, len(shapes))
+            row_buckets = max(1, len({b[0] for b in shapes}))
+            budgets.update({
+                f"sharded:init{tag}": 2 * buckets,
+                f"sharded:dir{tag}": buckets,
+                f"sharded:grad{tag}": 2 * buckets,
+                f"sharded:hvp{tag}": 2 * buckets,
+                f"sharded:trial{tag}": 4 * row_buckets,
+                f"sharded:curv{tag}": row_buckets,
+                f"sharded:acc{tag}": 4,
+                f"sharded:axpy{tag}": 2 * row_buckets,
+            })
+        if self.devices is not None:
+            budgets["sharded:combine"] = 4
+        return budgets
 
     def assert_trace_budget(self) -> None:
         """Compile-count invariant, asserted via the TracingGuard rather
         than hand-counted: each kernel family stays within
-        trace_budgets() (total <= KERNEL_FAMILIES x buckets + O(1))."""
+        trace_budgets() (total <= KERNEL_FAMILIES x buckets + O(1) per
+        device kit — each registered kernel's bound is per-bucket, so a
+        bigger mesh can never excuse more compiles per kernel)."""
         from photon_ml_tpu.utils.tracing_guard import RetraceError
 
         budgets = self.trace_budgets()
@@ -184,10 +402,6 @@ class ShardedGLMObjective:
                 f"{sorted(self.cache.bucket_shapes())})")
 
     # -- accumulation passes ----------------------------------------------
-
-    def _fold(self, acc, part):
-        """Left-fold in shard order — the deterministic combine."""
-        return part if acc is None else self._k_acc(acc, part)
 
     def _finish_grad(self, g_raw: Array, su: Array, coef: Array,
                      l2) -> Array:
@@ -205,22 +419,25 @@ class ShardedGLMObjective:
     def margins_value_grad(self, coef: Array, l2
                            ) -> Tuple[List[Array], Array, Array]:
         """One pass over the feature blocks: per-shard margins (kept as
-        device row-space state), the objective value, and the gradient."""
+        device row-space state, each on its shard's device), the
+        objective value, and the gradient."""
         z_list: List[Array] = []
-        acc = None
+        fold = self._new_fold()
         # The ``accumulate`` span covers the whole host-driven fold:
         # kernel dispatch is async, so its self-time is enqueue +
         # whatever the cache makes it wait for (shard_reupload /
         # prefetch_wait nest inside). Spans stay OUTSIDE the jitted
         # kernels (telemetry-in-trace rule).
         with span("accumulate"):
+            coefs = self._per_device(coef)
             for e in self.cache.blocks():
-                z, val, g_raw, su = self._k_init(
-                    e.feats, e.labels, e.offsets, e.weights, coef,
-                    n=e.n_rows)
+                with self._dev_span(e.slot):
+                    z, val, g_raw, su = self._kits[e.slot]["init"](
+                        e.feats, e.labels, e.offsets, e.weights,
+                        coefs[e.slot], n=e.n_rows)
                 z_list.append(z)
-                acc = self._fold(acc, (val, g_raw, su))
-        val, g_raw, su = acc
+                fold.add(e.slot, (val, g_raw, su))
+            val, g_raw, su = fold.result()
         f = val + 0.5 * l2 * jnp.vdot(coef, coef)
         return z_list, f, self._finish_grad(g_raw, su, coef, l2)
 
@@ -230,39 +447,59 @@ class ShardedGLMObjective:
 
     def margin_direction_list(self, direction: Array) -> List[Array]:
         """Per-shard directional margins (one feature pass)."""
+        out: List[Array] = []
         with span("accumulate"):
-            return [self._k_dir(e.feats, e.labels, e.offsets, e.weights,
-                                direction)
-                    for e in self.cache.blocks()]
+            dirs = self._per_device(direction)
+            for e in self.cache.blocks():
+                with self._dev_span(e.slot):
+                    out.append(self._kits[e.slot]["dir"](
+                        e.feats, e.labels, e.offsets, e.weights,
+                        dirs[e.slot]))
+        return out
 
     def trial_values(self, z_list: Sequence[Array],
                      zp_list: Sequence[Array], ts: Array,
                      coef_sq: Array, l2) -> Array:
         """Objective values at the [K] line-search candidates — row-space
         only (margins are cached), NO feature pass, no spill traffic."""
-        acc = None
-        for e, z, zp in zip(self.cache.entries, z_list, zp_list):
-            part = self._k_trial(z, zp, e.labels, e.weights, ts,
-                                 n=e.n_rows)
-            acc = self._fold(acc, part)
-        return acc + 0.5 * l2 * coef_sq
+        fold = self._new_fold()
+        with span("accumulate"):
+            tss = self._per_device(ts)
+            for e, z, zp in zip(self.cache.entries, z_list, zp_list):
+                with self._dev_span(e.slot):
+                    part = self._kits[e.slot]["trial"](
+                        z, zp, e.labels, e.weights, tss[e.slot], n=e.n_rows)
+                fold.add(e.slot, part)
+            res = fold.result()
+        return res + 0.5 * l2 * coef_sq
+
+    def update_margins(self, z_list: Sequence[Array], t,
+                       zp_list: Sequence[Array]) -> List[Array]:
+        """z + t*zp per shard — the accepted-step margin update, run on
+        each shard's own device (the expression the fused impl applies
+        to its whole margin vector, so the single-shard streamed solve
+        stays bitwise-identical to the fused solver)."""
+        tss = self._per_device(t)
+        return [self._kits[e.slot]["axpy"](z, tss[e.slot], zp)
+                for e, z, zp in zip(self.cache.entries, z_list, zp_list)]
 
     def grad_from_margins_list(self, coef: Array,
                                z_list: Sequence[Array], l2) -> Array:
         """Gradient given cached margins: one rmatvec pass."""
-        acc = None
+        fold = self._new_fold()
         with span("accumulate"):
-            blocks = self.cache.blocks()
-            for e, z in zip(blocks, z_list):
-                acc = self._fold(acc, self._k_grad(
-                    e.feats, e.labels, e.weights, z, n=e.n_rows))
-        g_raw, su = acc
+            for e, z in zip(self.cache.blocks(), z_list):
+                with self._dev_span(e.slot):
+                    part = self._kits[e.slot]["grad"](
+                        e.feats, e.labels, e.weights, z, n=e.n_rows)
+                fold.add(e.slot, part)
+            g_raw, su = fold.result()
         return self._finish_grad(g_raw, su, coef, l2)
 
     def curvature_list(self, z_list: Sequence[Array]) -> List[Array]:
         """d2_i = w_i l''(z_i, y_i) per shard — computed once per TRON
         outer iteration, row-space resident for the inner CG."""
-        return [self._k_curv(z, e.labels, e.weights)
+        return [self._kits[e.slot]["curv"](z, e.labels, e.weights)
                 for e, z in zip(self.cache.entries, z_list)]
 
     def hessian_vector(self, vec: Array, d2_list: Sequence[Array],
@@ -270,12 +507,14 @@ class ShardedGLMObjective:
         """H @ vec with precomputed curvature: one matvec + one rmatvec
         per shard (the streaming form of
         GLMObjective.hessian_vector_from_margins)."""
-        acc = None
+        fold = self._new_fold()
         with span("accumulate"):
-            blocks = self.cache.blocks()
-            for e, d2 in zip(blocks, d2_list):
-                acc = self._fold(acc, self._k_hvp(
-                    e.feats, e.labels, e.offsets, e.weights, d2, vec,
-                    n=e.n_rows))
-        r_raw, su = acc
+            vecs = self._per_device(vec)
+            for e, d2 in zip(self.cache.blocks(), d2_list):
+                with self._dev_span(e.slot):
+                    part = self._kits[e.slot]["hvp"](
+                        e.feats, e.labels, e.offsets, e.weights, d2,
+                        vecs[e.slot], n=e.n_rows)
+                fold.add(e.slot, part)
+            r_raw, su = fold.result()
         return self._finish_grad(r_raw, su, vec, l2)

@@ -296,6 +296,14 @@ def minimize_lbfgs_glm_streaming(
     results are deterministic and independent of cache residency (see
     the numeric contract in ops/sharded_objective.py; a single-shard
     cache reproduces the fused path bit for bit).
+
+    Mesh-aware transparently: when the sharded objective carries a 1-D
+    device mesh, every feature pass AND the per-shard margin updates run
+    on each shard's own device (the objective broadcasts
+    coefficients/steps and combines partials in fixed shard order); the
+    [d]-space outer iteration here — direction, history, convergence —
+    runs on the fold device. With the default "ordered" combine the
+    solve result is bit-identical for every device count.
     """
     import numpy as np
 
@@ -383,8 +391,10 @@ def minimize_lbfgs_glm_streaming(
                 break
 
             x_new = _stream_axpy(x, t_acc, direction)
-            z_new = [_stream_axpy(z, t_acc, zp)
-                     for z, zp in zip(z_list, zp_list)]
+            # Margins update on each shard's own device (mesh-aware; the
+            # same a + t*b expression as the fused impl, so single-shard
+            # bitwise identity holds).
+            z_new = sobj.update_margins(z_list, t_acc, zp_list)
             g_new = sobj.grad_from_margins_list(x_new, z_new, l2)
             hist = _stream_update_history(hist, x_new, x, g_new, g)
 

@@ -367,7 +367,11 @@ def minimize_tron_streaming(
     outer iteration, exactly like `GLMObjective.make_tron_hvp`; each CG
     product costs one matvec + one rmatvec per shard). Unsupported here:
     box constraints (use the resident path). Accumulation order is the
-    fixed shard order — deterministic, residency-independent."""
+    fixed shard order — deterministic, residency-independent, and (via
+    the objective's mesh) device-count-independent: per-shard curvature
+    stays resident on each shard's mesh device, each CG step broadcasts
+    the direction and folds the Hvp partials in fixed shard order, while
+    the [d]-space trust-region algebra here runs on the fold device."""
     import numpy as np
 
     sobj = sharded_objective

@@ -9,6 +9,7 @@ from photon_ml_tpu.parallel.distributed import (
     MODEL_AXIS,
     make_mesh,
     make_mesh_2d,
+    mesh_device_list,
     replicate,
     shard_batch,
     shard_batch_csr_feature_dim,
@@ -23,6 +24,7 @@ __all__ = [
     "MODEL_AXIS",
     "make_mesh",
     "make_mesh_2d",
+    "mesh_device_list",
     "replicate",
     "shard_batch",
     "shard_batch_csr_feature_dim",

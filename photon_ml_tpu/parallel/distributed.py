@@ -58,6 +58,21 @@ def make_mesh(num_devices: Optional[int] = None,
     return Mesh(np.asarray(devs), (axis,))
 
 
+def mesh_device_list(mesh: Mesh) -> list:
+    """Devices of a 1-D mesh in axis order — the round-robin assignment
+    and fixed fold order of the mesh-parallel streamed objective
+    (ops/sharded_objective.py): shard-cache block i lives on
+    ``mesh_device_list(mesh)[i % D]``, and cross-device partials combine
+    in this order. Rejects 2-D meshes: the streamed fold's device axis
+    is one-dimensional (the feature/column axis composes separately via
+    :func:`shard_batch_csr_feature_dim`)."""
+    if len(mesh.shape) != 1:
+        raise ValueError(
+            f"expected a 1-D mesh, got axes {tuple(mesh.shape)} — the "
+            "streamed device fold round-robins blocks over one axis")
+    return list(np.asarray(mesh.devices).flat)
+
+
 def make_mesh_2d(num_data: int, num_model: int,
                  data_axis: str = DATA_AXIS,
                  model_axis: str = MODEL_AXIS) -> Mesh:

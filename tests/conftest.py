@@ -105,6 +105,57 @@ def rng():
 
 
 @pytest.fixture
+def multi_device():
+    """Run a python snippet under a jax that sees EXACTLY ``n_devices``
+    virtual CPU devices, in a fresh subprocess (``XLA_FLAGS
+    --xla_force_host_platform_device_count`` must land before jax
+    initializes — the tests/multihost_worker.py pattern). This harness
+    process is pinned to 8 virtual devices, so total-device-count
+    behavior (``--mesh-devices`` on an N-chip host) is only testable in
+    a child; the fixture SKIPS (never fails) when a child cannot be
+    spawned at all — constrained sandboxes — and raises with the
+    child's output on a genuine in-child failure.
+
+    Usage::
+
+        def test_x(multi_device):
+            proc = multi_device(2, "import jax; print(jax.device_count())")
+            assert proc.stdout.strip() == "2"
+    """
+    import subprocess
+    import sys
+
+    from photon_ml_tpu.utils.virtual_devices import forced_cpu_device_env
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(n_devices: int, code: str, timeout: float = 600.0,
+            env: dict = None) -> "subprocess.CompletedProcess":
+        child_env = forced_cpu_device_env(n_devices, os.environ)
+        child_env.update(env or {})
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], env=child_env,
+                capture_output=True, text=True, timeout=timeout,
+                cwd=repo_root)
+        except subprocess.TimeoutExpired as exc:
+            raise AssertionError(
+                f"{n_devices}-device subprocess hung past {timeout}s:\n"
+                f"STDOUT:\n{exc.stdout}\nSTDERR:\n{exc.stderr}") from exc
+        except (OSError, subprocess.SubprocessError) as exc:
+            pytest.skip(
+                f"cannot spawn a {n_devices}-device subprocess: {exc!r}")
+        if proc.returncode != 0:
+            raise AssertionError(
+                f"{n_devices}-device subprocess failed "
+                f"(rc={proc.returncode}):\nSTDOUT:\n{proc.stdout}\n"
+                f"STDERR:\n{proc.stderr}")
+        return proc
+
+    return run
+
+
+@pytest.fixture
 def tracing_guard():
     """Shared retrace-guard fixture (utils/tracing_guard.py): yields a
     fresh TracingGuard; budgets a test declares (track(..., max_traces=)

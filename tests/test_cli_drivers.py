@@ -532,7 +532,7 @@ def test_stream_train_resident_model_identical_to_one_shot(tmp_path, rng):
             base + ["--output-dir", str(st), "--stream-train",
                     "--batch-rows", "33"])
         assert _coeff_records(one) == _coeff_records(st), tag
-        info = summary["streamTrain"]
+        info = summary["stream_train"]
         assert info["mode"] == "resident-assembled"
         assert info["feeder"]["rows"] == 220
         assert info["feeder"]["batches"] == 7  # ceil(220/33)
@@ -555,8 +555,8 @@ def test_stream_train_spill_identical_across_residency(tmp_path, rng):
         base + ["--output-dir", str(tmp_path / "small"), "--stream-train",
                 "--batch-rows", "64", "--hbm-budget", "8K",
                 "--feeder", "python", "--prefetch-batches", "0"])
-    assert big["streamTrain"]["cache"]["evictions"] == 0
-    assert small["streamTrain"]["cache"]["evictions"] > 0
+    assert big["stream_train"]["cache"]["evictions"] == 0
+    assert small["stream_train"]["cache"]["evictions"] > 0
     assert _coeff_records(tmp_path / "big") == \
         _coeff_records(tmp_path / "small")
     ref = {r["name"]: r["value"]
@@ -570,21 +570,86 @@ def test_stream_train_spill_identical_across_residency(tmp_path, rng):
     assert one["numRows"] == big["numRows"] == 300
 
 
+def test_stream_train_mesh_model_identical_across_mesh_sizes(tmp_path,
+                                                             rng):
+    """Tentpole acceptance: --mesh-devices 1 writes the PR-5
+    single-device fold's model bit for bit, and mesh sizes {2, 4} write
+    byte-identical model artifacts to each other (and, by the ordered
+    shard-order combine, to the 1-device fold), with compile counts
+    bounded per bucket through the TracingGuard."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64", "--hbm-budget", "8K"]
+    no_mesh = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "nomesh")])
+    ref = _coeff_records(tmp_path / "nomesh")
+    for n_dev in (1, 2, 4):
+        out = tmp_path / f"mesh{n_dev}"
+        summary = game_training_driver.run(
+            base + ["--output-dir", str(out),
+                    "--mesh-devices", str(n_dev)])
+        assert _coeff_records(out) == ref, n_dev
+        info = summary["stream_train"]
+        assert info["mesh_devices"] == n_dev
+        assert info["cache"]["mesh_devices"] == (n_dev if n_dev > 1
+                                                 else None)
+        assert info["cache"]["evictions"] > 0, n_dev
+        for name, count in info["trace_counts"].items():
+            assert count <= info["trace_budgets"][name], (n_dev, name)
+        if n_dev > 1:
+            # per-device kernels registered; budget binds PER device
+            assert any(k.startswith("sharded:init@d")
+                       for k in info["trace_counts"])
+            assert len(info["cache"]["per_device_bytes"]) == n_dev
+
+
+def test_mesh_devices_flag_validation(tmp_path, rng):
+    """--mesh-devices composes only with the sharded streaming solve:
+    it needs --stream-train, > 1 needs --hbm-budget, and more devices
+    than the host exposes fails with the mesh builder's error."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=60)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE
+    with pytest.raises(ValueError, match="--stream-train"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "a"),
+                    "--mesh-devices", "2"])
+    with pytest.raises(ValueError, match="--hbm-budget"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "b"), "--stream-train",
+                    "--mesh-devices", "2"])
+    with pytest.raises(ValueError, match="devices"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "c"), "--stream-train",
+                    "--hbm-budget", "8K", "--mesh-devices", "64"])
+    # N=1 composes with BOTH modes (it is the single-device fold)
+    summary = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "d"), "--stream-train",
+                "--mesh-devices", "1", "--batch-rows", "32"])
+    assert summary["stream_train"]["mesh_devices"] == 1
+
+
 def _assert_stream_train_telemetry(out_dir, summary, feeder):
-    info = summary["streamTrain"]
+    info = summary["stream_train"]
     assert info["feeder"]["decode_path"] == feeder
-    for key in ("mode", "batchRows", "hbmBudgetBytes", "feeder", "cache"):
+    for key in ("mode", "batch_rows", "hbm_budget_bytes", "mesh_devices",
+                "feeder", "cache"):
         assert key in info, key
     if info["cache"] is not None:
         for key in ("hits", "misses", "evictions", "bytes_reuploaded",
-                    "peak_device_bytes", "bucket_shapes"):
+                    "peak_device_bytes", "bucket_shapes", "mesh_devices",
+                    "per_device_bytes"):
             assert key in info["cache"], key
-        assert "traceBudgets" in info and "traceCounts" in info
-        for name, count in info["traceCounts"].items():
-            assert count <= info["traceBudgets"][name], name
+        assert "trace_budgets" in info and "trace_counts" in info
+        for name, count in info["trace_counts"].items():
+            assert count <= info["trace_budgets"][name], name
+    # the deprecated camelCase alias is gone (rode one release behind)
+    assert "streamTrain" not in summary
     # the telemetry must round-trip through the metrics.json artifact
     on_disk = json.loads((out_dir / "metrics.json").read_text())
-    assert on_disk["streamTrain"] == json.loads(json.dumps(info))
+    assert on_disk["stream_train"] == json.loads(json.dumps(info))
+    assert "streamTrain" not in on_disk
 
 
 def test_stream_train_smoke_python_feeder(tmp_path, rng):
@@ -603,7 +668,7 @@ def test_stream_train_smoke_python_feeder(tmp_path, rng):
                 "--batch-rows", "32", "--feeder", "python",
                 "--hbm-budget", "4K"])
     _assert_stream_train_telemetry(tmp_path / "spill", s_spill, "python")
-    assert s_spill["streamTrain"]["mode"] == "spill"
+    assert s_spill["stream_train"]["mode"] == "spill"
 
 
 @pytest.mark.native_decoder
@@ -725,13 +790,13 @@ def test_stream_train_emits_training_events(tmp_path, rng, monkeypatch):
     assert evs[-1]["duration_seconds"] > 0
 
 
-def test_stream_train_snake_schema_alias_and_trace(tmp_path, rng):
+def test_stream_train_snake_schema_and_trace(tmp_path, rng):
     """Satellite + tentpole acceptance: the metrics.json stream block is
-    snake_case (``stream_train``) with the camelCase ``streamTrain``
-    alias one release behind; the run writes a Perfetto-loadable trace
-    and a telemetry block whose stage attribution explains >= 90% of the
-    end-to-end wall time, with solver-iteration timing from the
-    histogram."""
+    snake_case (``stream_train``); the deprecated camelCase
+    ``streamTrain`` alias — kept one release behind by PR 6 — is now
+    REMOVED. The run writes a Perfetto-loadable trace and a telemetry
+    block whose stage attribution explains >= 90% of the end-to-end
+    wall time, with solver-iteration timing from the histogram."""
     train = tmp_path / "train"
     _write_sparse_fe_avro(train, rng, n=120)
     trace_path = tmp_path / "trace.json"
@@ -743,13 +808,12 @@ def test_stream_train_snake_schema_alias_and_trace(tmp_path, rng):
 
     info = summary["stream_train"]
     assert set(info) == {"mode", "batch_rows", "hbm_budget_bytes",
-                         "feeder", "cache", "trace_budgets",
-                         "trace_counts"}
-    legacy = summary["streamTrain"]
-    assert legacy["batchRows"] == info["batch_rows"] == 32
-    assert legacy["hbmBudgetBytes"] == info["hbm_budget_bytes"]
-    assert legacy["mode"] == info["mode"] == "spill"
-    assert legacy["traceBudgets"] == info["trace_budgets"]
+                         "mesh_devices", "feeder", "cache",
+                         "trace_budgets", "trace_counts"}
+    assert info["batch_rows"] == 32
+    assert info["mode"] == "spill"
+    assert info["mesh_devices"] is None
+    assert "streamTrain" not in summary  # deprecated alias removed
 
     tele = summary["telemetry"]
     assert tele["attributed_wall_frac"] >= 0.9

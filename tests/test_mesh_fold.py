@@ -1,0 +1,430 @@
+"""Mesh-parallel streamed training: device-count invariance of the
+sharded objective fold (ops/sharded_objective.py `mesh=`,
+data/shard_cache.py `devices=`).
+
+The PR-5 contract extended one axis: the fold combines per-shard
+partials in FIXED GLOBAL SHARD ORDER no matter which mesh device
+computed them, and a given executable is bitwise-deterministic on every
+device of a homogeneous mesh — so with the default "ordered" combine
+the device count changes NOTHING:
+
+- mesh sizes {1, 2, 4} produce bit-identical (value, gradient, Hvp) and
+  bit-identical streamed L-BFGS / TRON solutions, all equal to the
+  non-mesh fold (a 1-device mesh IS the single-device code path);
+- residency independence (resident == eviction-forced == zero-prefetch)
+  is preserved under a mesh, with the HBM budget binding PER DEVICE;
+- per-device kernel compile counts stay within the per-BUCKET budgets
+  (TracingGuard-asserted): a bigger mesh never buys a kernel more
+  compiles.
+
+The "local" combine (per-device left-folds + fixed device-order apex —
+the psum/treeAggregate shape) is deterministic for fixed (shards,
+devices), identical to "ordered" at 1 device, and within documented f32
+reassociation bounds otherwise.
+
+The subprocess test drives the REAL total-device-count axis: the CLI
+driver runs in children whose jax sees exactly N devices
+(`multi_device` fixture) and the written model bytes must not depend on
+N.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.shard_cache import DeviceShardCache
+from photon_ml_tpu.ops.glm_objective import GLMObjective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.sharded_objective import ShardedGLMObjective
+from photon_ml_tpu.optimization.glm_lbfgs import (
+    minimize_lbfgs_glm_streaming,
+)
+from photon_ml_tpu.optimization.tron import minimize_tron_streaming
+from photon_ml_tpu.parallel import make_mesh, mesh_device_list
+from photon_ml_tpu.types import TaskType
+
+from tests.test_shard_cache import FakeStream
+
+
+@pytest.fixture
+def problem(rng):
+    n, d = 1003, 41
+    X = sp.random(n, d, density=0.1, random_state=11, format="csr")
+    X.data[:] = rng.normal(0, 1, X.nnz)
+    y = (rng.random(n) < 0.5).astype(float)
+    off = rng.normal(0, 0.1, n)
+    w = rng.gamma(1.0, 1.0, n)
+    return X, y, off, w
+
+
+def _bits(x):
+    return np.asarray(x).tobytes()
+
+
+def _sobj(problem, mesh_n=None, budget=None, batch_rows=128,
+          combine="ordered", prefetch_depth=None):
+    X, y, off, w = problem
+    mesh = make_mesh(mesh_n) if mesh_n else None
+    devices = (mesh_device_list(mesh)
+               if mesh is not None and mesh_n > 1 else None)
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, batch_rows, off, w), "g",
+        hbm_budget_bytes=budget, devices=devices)
+    if prefetch_depth is not None:
+        cache.prefetch_depth = prefetch_depth
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    return ShardedGLMObjective(obj, cache, mesh=mesh, combine=combine)
+
+
+def _block_bytes(problem):
+    return max(e.feature_bytes
+               for e in _sobj(problem).cache.entries)
+
+
+def test_mesh_value_grad_hvp_bitwise_across_mesh_sizes(problem, rng):
+    """The acceptance contract: every fold quantity is bit-identical for
+    mesh sizes {1, 2, 4} and equal to the non-mesh fold."""
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    vec = jnp.asarray(rng.normal(0, 1.0, X.shape[1]), jnp.float32)
+    l2 = jnp.asarray(0.7, jnp.float32)
+
+    ref = _sobj(problem)
+    z_ref, f_ref, g_ref = ref.margins_value_grad(coef, l2)
+    hv_ref = ref.hessian_vector(vec, ref.curvature_list(z_ref), l2)
+    for mesh_n in (1, 2, 4):
+        s = _sobj(problem, mesh_n=mesh_n)
+        z, f, g = s.margins_value_grad(coef, l2)
+        assert _bits(f) == _bits(f_ref), mesh_n
+        assert _bits(g) == _bits(g_ref), mesh_n
+        # per-shard margins are row-local device state — same bits no
+        # matter which device holds them
+        for za, zb in zip(z, z_ref):
+            assert _bits(za) == _bits(zb)
+        hv = s.hessian_vector(vec, s.curvature_list(z), l2)
+        assert _bits(hv) == _bits(hv_ref), mesh_n
+        if mesh_n == 1:
+            # a 1-device mesh IS the single-device fold
+            assert s.devices is None and s.mesh is None
+
+
+def test_mesh_residency_independence(problem, rng):
+    """resident == eviction-forced == zero-prefetch under a 2-device
+    mesh, bit for bit, with the budget binding per device."""
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    l2 = jnp.asarray(0.7, jnp.float32)
+    block = _block_bytes(problem)
+
+    resident = _sobj(problem, mesh_n=2)
+    fr, gr = resident.value_and_grad(coef, l2)
+    for budget, depth in [(block, 2), (2 * block, 0)]:
+        spill = _sobj(problem, mesh_n=2, budget=budget,
+                      prefetch_depth=depth)
+        fs, gs = spill.value_and_grad(coef, l2)
+        assert _bits(fs) == _bits(fr)
+        assert _bits(gs) == _bits(gr)
+        stats = spill.cache.stats()
+        assert stats["evictions"] > 0
+        assert stats["mesh_devices"] == 2
+        # the budget is PER DEVICE: each slot honors it independently
+        # (the in-hand block may transiently exceed it, as in PR 5)
+        assert all(b <= budget + block
+                   for b in stats["per_device_bytes"])
+
+
+def test_mesh_streaming_solvers_bitwise_across_mesh_sizes(problem):
+    """Full streamed L-BFGS and TRON solves write the same coefficient
+    bits for mesh sizes {1, 2, 4} (spill-forced) as without a mesh."""
+    X = problem[0]
+    x0 = jnp.zeros(X.shape[1], jnp.float32)
+    l2 = jnp.asarray(0.5, jnp.float32)
+    block = _block_bytes(problem)
+
+    ref_l = minimize_lbfgs_glm_streaming(_sobj(problem), x0, l2,
+                                         max_iter=20)
+    ref_t = minimize_tron_streaming(_sobj(problem), x0, l2, max_iter=6)
+    for mesh_n in (1, 2, 4):
+        s = _sobj(problem, mesh_n=mesh_n, budget=block)
+        got = minimize_lbfgs_glm_streaming(s, x0, l2, max_iter=20)
+        assert _bits(got.x) == _bits(ref_l.x), mesh_n
+        assert int(got.iterations) == int(ref_l.iterations)
+        assert int(got.reason) == int(ref_l.reason)
+        if mesh_n > 1:
+            assert s.cache.stats()["evictions"] > 0
+        t = minimize_tron_streaming(
+            _sobj(problem, mesh_n=mesh_n, budget=block), x0, l2,
+            max_iter=6)
+        assert _bits(t.x) == _bits(ref_t.x), mesh_n
+
+
+def test_local_combine_bounded_reassociation(problem, rng):
+    """combine="local" (per-device folds + device-order apex): identical
+    to "ordered" at 1 device, deterministic and within f32
+    reassociation bounds at 4."""
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    l2 = jnp.asarray(0.7, jnp.float32)
+
+    f0, g0 = _sobj(problem).value_and_grad(coef, l2)
+    f1, g1 = _sobj(problem, mesh_n=1,
+                   combine="local").value_and_grad(coef, l2)
+    assert _bits(f1) == _bits(f0) and _bits(g1) == _bits(g0)
+
+    f4a, g4a = _sobj(problem, mesh_n=4,
+                     combine="local").value_and_grad(coef, l2)
+    f4b, g4b = _sobj(problem, mesh_n=4,
+                     combine="local").value_and_grad(coef, l2)
+    # deterministic for fixed (shards, devices)...
+    assert _bits(f4a) == _bits(f4b) and _bits(g4a) == _bits(g4b)
+    # ...and within the documented reassociation bound of "ordered"
+    np.testing.assert_allclose(np.asarray(f4a), np.asarray(f0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g4a), np.asarray(g0),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_mesh_per_device_budget_and_placement(problem):
+    """Blocks place round-robin (block i on device i mod D), spill
+    re-uploads return to the assigned device, and each device's
+    resident bytes honor the budget independently."""
+    import jax
+
+    X = problem[0]
+    devices = jax.devices()[:4]
+    mesh = make_mesh(4)
+    assert mesh_device_list(mesh) == devices
+    block = _block_bytes(problem)
+    cache = DeviceShardCache.from_stream(
+        FakeStream(problem[0], problem[1], 128, problem[2], problem[3]),
+        "g", hbm_budget_bytes=block, devices=devices)
+    assert cache.n_slots == 4
+    for e in cache.entries:
+        assert e.slot == e.index % 4
+        assert e.device is devices[e.slot]
+        for arr in (e.labels, e.offsets, e.weights):
+            assert arr.devices() == {devices[e.slot]}
+    # replay an epoch: every handed-out block is resident on ITS device
+    for b in cache.blocks(prefetch_depth=0):
+        assert b.slot == b.index % 4
+        assert b.feats.values.devices() == {devices[b.slot]}
+    stats = cache.stats()
+    assert stats["mesh_devices"] == 4
+    assert len(stats["per_device_bytes"]) == 4
+    assert sum(stats["per_device_resident_shards"]) == \
+        stats["resident_shards"]
+    assert all(b <= block for b in stats["per_device_bytes"])
+
+
+def test_mesh_trace_budgets_per_bucket_not_per_device(problem):
+    """Every per-device kernel is registered in the guard and stays
+    within its per-BUCKET budget across a λ-grid sweep + TRON — and no
+    single kernel's count grows with the mesh size."""
+    X = problem[0]
+    x0 = jnp.zeros(X.shape[1], jnp.float32)
+    block = _block_bytes(problem)
+
+    counts_by_mesh = {}
+    for mesh_n in (1, 2, 4):
+        s = _sobj(problem, mesh_n=mesh_n, budget=block)
+        for l2 in (0.1, 1.0, 10.0):
+            minimize_lbfgs_glm_streaming(
+                s, x0, jnp.asarray(l2, jnp.float32), max_iter=8)
+        minimize_tron_streaming(s, x0, jnp.asarray(0.5, jnp.float32),
+                                max_iter=4)
+        s.assert_trace_budget()
+        counts = s.guard.counts()
+        budgets = s.trace_budgets()
+        assert set(counts) <= set(budgets)
+        for name, c in counts.items():
+            assert c <= budgets[name], (mesh_n, name, c, budgets[name])
+        if mesh_n > 1:
+            # every per-device kernel family is registered per device
+            for k in range(mesh_n):
+                assert f"sharded:init@d{k}" in counts
+            assert "sharded:combine" in counts
+        counts_by_mesh[mesh_n] = counts
+
+    # compiles scale with bucket count, not device count: the max count
+    # of any single registered kernel is no larger on the 4-device mesh
+    # than on the 1-device fold
+    per_kernel_max = {m: max(c.values())
+                      for m, c in counts_by_mesh.items()}
+    assert per_kernel_max[4] <= per_kernel_max[1] + 0
+
+
+def test_mesh_fold_telemetry_spans(problem, rng):
+    """Mesh folds emit one span family per device-fold stage
+    (device_fold:dK) plus the cross-device combine, so Perfetto traces
+    and the stage attribution break the accumulate down per device."""
+    from photon_ml_tpu import telemetry
+
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    telemetry.reset()
+    telemetry.enable(trace=True)
+    try:
+        s = _sobj(problem, mesh_n=2)
+        s.value_and_grad(coef, 0.5)
+        att = telemetry.stage_attribution()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert "accumulate" in att
+    assert "device_fold:d0" in att and "device_fold:d1" in att
+    assert "cross_device_combine" in att
+    # the non-mesh fold keeps PR-5's span structure untouched
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        _sobj(problem).value_and_grad(coef, 0.5)
+        att = telemetry.stage_attribution()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert "accumulate" in att
+    assert not any(k.startswith("device_fold") for k in att)
+
+
+def test_mesh_validation_errors(problem):
+    """Mis-wiring fails loudly: mesh without a placed cache, cache on
+    different devices, bad combine, 2-D mesh."""
+    import jax
+
+    X, y, off, w = problem
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    mesh = make_mesh(2)
+    unplaced = DeviceShardCache.from_stream(
+        FakeStream(X, y, 200, off, w), "g")
+    with pytest.raises(ValueError, match="same devices"):
+        ShardedGLMObjective(obj, unplaced, mesh=mesh)
+    wrong = DeviceShardCache.from_stream(
+        FakeStream(X, y, 200, off, w), "g",
+        devices=list(reversed(jax.devices()[:2])))
+    with pytest.raises(ValueError, match="same devices"):
+        ShardedGLMObjective(obj, wrong, mesh=mesh)
+    with pytest.raises(ValueError, match="combine"):
+        ShardedGLMObjective(obj, unplaced, combine="tree")
+    # the converse mis-wiring: mesh-placed cache, mesh-less objective
+    placed = DeviceShardCache.from_stream(
+        FakeStream(X, y, 200, off, w), "g",
+        devices=mesh_device_list(mesh))
+    with pytest.raises(ValueError, match="without a mesh"):
+        ShardedGLMObjective(obj, placed)
+    from photon_ml_tpu.parallel import make_mesh_2d
+
+    with pytest.raises(ValueError, match="1-D mesh"):
+        mesh_device_list(make_mesh_2d(2, 2))
+
+
+def test_forced_cpu_device_env_scrubs_and_pins():
+    """The shared child-env builder (conftest multi_device + the bench
+    mesh children) replaces an inherited device-count force and pins
+    the platform."""
+    from photon_ml_tpu.utils.virtual_devices import forced_cpu_device_env
+
+    env = forced_cpu_device_env(3, {
+        "XLA_FLAGS": "--foo --xla_force_host_platform_device_count=8",
+        "JAX_PLATFORMS": "tpu", "OTHER": "kept"})
+    assert env["XLA_FLAGS"] == \
+        "--foo --xla_force_host_platform_device_count=3"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["OTHER"] == "kept"
+
+
+def test_streaming_coordinate_mesh_mismatch(problem):
+    """A shared sharded objective must carry the coordinate's mesh."""
+    from photon_ml_tpu.algorithm.coordinates import (
+        StreamingFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+    )
+
+    X, y, off, w = problem
+    mesh = make_mesh(2)
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 200, off, w), "g",
+        devices=mesh_device_list(mesh))
+    cfg = GLMOptimizationConfiguration.parse("5,1e-6,1.0,1.0,LBFGS,L2")
+    coord = StreamingFixedEffectCoordinate(
+        name="fe", cache=cache, feature_shard_id="g",
+        task_type=TaskType.LOGISTIC_REGRESSION, config=cfg, mesh=mesh)
+    assert coord.sharded_objective.devices == mesh_device_list(mesh)
+    with pytest.raises(ValueError, match="same mesh"):
+        StreamingFixedEffectCoordinate(
+            name="fe", cache=cache, feature_shard_id="g",
+            task_type=TaskType.LOGISTIC_REGRESSION, config=cfg,
+            sharded_objective=coord.sharded_objective, mesh=None)
+    model, result = coord.solve()
+    assert model.glm.coefficients.means.shape == (X.shape[1],)
+    assert int(result.iterations) > 0
+
+
+_CHILD_DRIVER = """
+import hashlib
+import json
+from pathlib import Path
+
+import jax
+
+n_devices, out_dir, train_dir = __N__, __OUT__, __TRAIN__
+assert jax.device_count() == n_devices, (
+    f"child expected {n_devices} devices, jax sees "
+    f"{jax.device_count()}")
+
+from photon_ml_tpu.cli import game_training_driver
+from photon_ml_tpu.io.avro_codec import read_container
+
+summary = game_training_driver.run([
+    "--train-input-dirs", train_dir,
+    "--output-dir", out_dir,
+    "--task-type", "LOGISTIC_REGRESSION",
+    "--fixed-effect-data-configurations", "fixed:global",
+    "--fixed-effect-optimization-configurations",
+    "fixed:25,1e-7,1.0,1.0,LBFGS,L2",
+    "--updating-sequence", "fixed",
+    "--stream-train", "--batch-rows", "48",
+    "--hbm-budget", "8K", "--mesh-devices", str(n_devices),
+])
+info = summary["stream_train"]
+assert info["mesh_devices"] == n_devices
+assert "streamTrain" not in summary  # legacy alias removed
+records = list(read_container(
+    Path(out_dir) / "best" / "fixed-effect" / "fixed" / "coefficients"
+    / "part-00000.avro"))
+print("COEFF_SHA", hashlib.sha256(
+    json.dumps(records, sort_keys=True).encode()).hexdigest())
+print("MESH_CHILD_OK", n_devices)
+"""
+
+
+def test_driver_mesh_model_bytes_independent_of_total_device_count(
+        tmp_path, rng, multi_device):
+    """End-to-end on the REAL device-count axis: the spill-mode driver
+    runs in subprocesses whose jax sees exactly N in {1, 2, 4} devices
+    (this harness is pinned to 8 virtual devices; a real host has
+    however many chips it has), with --mesh-devices N — the decoded
+    coefficient records must be identical across N (the container
+    header embeds a random sync marker, so decoded records are the
+    byte-identity comparison unit)."""
+    from tests.test_cli_drivers import _write_sparse_fe_avro
+
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=150)
+    shas = {}
+    for n_dev in (1, 2, 4):
+        out = tmp_path / f"out{n_dev}"
+        code = (_CHILD_DRIVER
+                .replace("__N__", str(n_dev))
+                .replace("__OUT__", repr(str(out)))
+                .replace("__TRAIN__", repr(str(train))))
+        proc = multi_device(n_dev, code, timeout=420)
+        assert f"MESH_CHILD_OK {n_dev}" in proc.stdout
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("COEFF_SHA")][0]
+        shas[n_dev] = line.split()[1]
+    # decoded coefficient records identical for every total device count
+    assert len(set(shas.values())) == 1, shas
