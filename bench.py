@@ -1036,6 +1036,320 @@ def serving_bench():
     }
 
 
+def _frontend_model_variant(model, factor=1.01):
+    """Same-STRUCTURE weight variant of a trained GAME model (the A/B
+    tenancy shape): fixed-effect coefficients scale, every shape/vocab
+    stays — so the shared executable cache must not grow."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models import Coefficients, FixedEffectModel
+
+    for name, m in model.models.items():
+        if isinstance(m, FixedEffectModel):
+            glm = type(m.glm)(Coefficients(
+                jnp.asarray(m.glm.coefficients.means) * factor))
+            return model.update_model(
+                name, FixedEffectModel(glm, m.feature_shard_id))
+    raise RuntimeError("model has no fixed-effect coordinate to vary")
+
+
+#: PR 2's measured uncoalesced batch=1 serving rate on this host
+#: (docs/SCALE.md §Serving) — the baseline the ISSUE-8 20x target is
+#: quoted against. Frozen here because this PR's dispatch-staging fix
+#: speeds up the LIVE batch=1 measurement itself ~5x.
+SEED_BATCH1_ROWS_PER_SEC = 800.0
+
+
+def serving_frontend_bench():
+    """Async serving front-end (photon_ml_tpu/serving/frontend.py):
+    coalesced CONCURRENT single-row throughput vs the uncoalesced
+    batch=1 baseline across the coalesce-window {0,1,2,5 ms} x
+    concurrency {1,16,64} sweep (P50/P99 per cell from the frontend's
+    end-to-end histogram), load-shed rate under 2x open-loop overload,
+    heavy-tailed traffic (Zipf request sizes, Poisson arrivals), and the
+    2-model tenancy compile bound asserted through the shared
+    ExecutableCache's TracingGuard. Single-core host: the event loop,
+    featureization, and the XLA:CPU dispatch all timeshare one core —
+    record cpu_cores and the honest curve."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.algorithm import CoordinateDescent
+    from photon_ml_tpu.serving import (
+        BucketLadder,
+        FrontendConfig,
+        ServingFrontend,
+        StreamingGameScorer,
+    )
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.utils.tracing_guard import RetraceError
+
+    try:
+        cpu_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cpu_cores = os.cpu_count() or 1
+
+    full = SHAPE_SCALE == "full"
+    data = build_problem()
+    cd = CoordinateDescent(build_coords(data, full_game=True),
+                           TaskType.LOGISTIC_REGRESSION)
+    model = cd.run(num_iterations=1).model
+
+    n_pool = int(os.environ.get("PHOTON_BENCH_SERVING_ROWS") or
+                 (60_000 if full else 4_000))
+    pool = _serving_request_pool(n_pool, D_FIXED, N_USERS, D_USER,
+                                 N_ITEMS, D_ITEM)
+    ladder = BucketLadder(min_rows=16, max_rows=4096)
+
+    # Distinct single-row request objects, reused round-robin (cached
+    # pool slices — the PR 2 request-pool pattern): request CONSTRUCTION
+    # is the caller's cost, not the front-end's.
+    n_singles = 256
+    singles = [pool.subset(np.arange(i, i + 1)) for i in range(n_singles)]
+
+    # -- uncoalesced batch=1 baseline: sequential engine.score ------------
+    # NOTE this baseline is itself ~5x faster than the PR 2 measurement
+    # (0.8k req/s, docs/SCALE.md §Serving): the dispatch-staging fix
+    # that rode along with the front-end (engine._dispatch hands
+    # serving-sized buckets straight to the jitted call's C++ argument
+    # transfer instead of per-leaf python device_put) cuts batch=1
+    # latency from ~1.3ms to ~0.25ms. Both ratios are reported below.
+    base_engine = StreamingGameScorer(model, ladder=ladder)
+    base_engine.score(singles[0])  # warm the 1-row bucket
+    n_base = 128 if full else 64
+    base_rps = 0.0
+    for _ in range(3):  # best-of-3: 1-core timing noise
+        t0 = time.perf_counter()
+        for r in singles[:n_base]:
+            base_engine.score(r)
+        base_rps = max(base_rps, n_base / (time.perf_counter() - t0))
+
+    # The engine's own batched ceiling on the SAME single-row requests
+    # (score_many packs them into full buckets in one call) — the
+    # "batched dispatch rate" the coalescer is supposed to approach.
+    n_batched = 512 if full else 256
+    batched_reqs = [singles[i % n_singles] for i in range(n_batched)]
+    base_engine.score_many(batched_reqs)  # warm the packed-group bucket
+    t0 = time.perf_counter()
+    base_engine.score_many(batched_reqs)
+    batched_rps = n_batched / (time.perf_counter() - t0)
+
+    # -- coalesce-window x concurrency sweep -------------------------------
+    frontend = ServingFrontend(
+        {"default": model}, ladder=ladder,
+        config=FrontendConfig(coalesce_window_s=0.0, max_pending=4096))
+    frontend.replay(singles, concurrency=64)  # warm group-size buckets
+    k_req = 2048 if full else 768
+    cells = {}
+    for w_ms in (0.0, 1.0, 2.0, 5.0):
+        frontend.coalesce_window_s = w_ms / 1e3
+        for conc in (1, 16, 64):
+            reqs = [singles[i % n_singles] for i in range(k_req)]
+            cell = None
+            for _ in range(2):  # best-of-2: 1-core timing noise
+                telemetry.reset()
+                telemetry.enable()
+                t0 = time.perf_counter()
+                _, info = frontend.replay(reqs, concurrency=conc)
+                dt = time.perf_counter() - t0
+                lat = telemetry.histogram(
+                    "serving.frontend.request_latency_seconds").snapshot()
+                qw = telemetry.histogram(
+                    "serving.frontend.queue_wait_seconds").snapshot()
+                groups = telemetry.histogram(
+                    "serving.frontend.coalesce_group_requests")
+                n_groups = groups.count
+                telemetry.disable()
+                assert info["shed"] == 0 and info["errors"] == 0
+                if cell is not None and k_req / dt <= cell["rows_per_sec"]:
+                    continue
+                cell = {
+                    "rows_per_sec": round(k_req / dt, 1),
+                    "p50_ms": round(lat["p50"] * 1e3, 3),
+                    "p99_ms": round(lat["p99"] * 1e3, 3),
+                    "queue_wait_p99_ms": round(qw["p99"] * 1e3, 3),
+                    "mean_group_requests": (round(k_req / n_groups, 2)
+                                            if n_groups else None),
+                }
+            cells[f"w{w_ms:g}ms_c{conc}"] = cell
+    # No silent retrace anywhere in the sweep (group sizes quantize into
+    # ladder buckets; every executable traced exactly once).
+    try:
+        frontend.cache.assert_max_retraces(per_fn=1)
+        sweep_per_fn_ok = True
+    except RetraceError:
+        sweep_per_fn_ok = False
+    conc64 = {k: v for k, v in cells.items() if k.endswith("_c64")}
+    best_key = max(conc64, key=lambda k: conc64[k]["rows_per_sec"])
+    best_rps = conc64[best_key]["rows_per_sec"]
+    ratio_live = best_rps / base_rps if base_rps else float("nan")
+    # The ISSUE-8 20x target is anchored to the batch=1 baseline it
+    # quotes — the PR 2 serving-bench measurement (0.8k req/s on this
+    # host, docs/SCALE.md §Serving). This PR moves BOTH terms: the
+    # dispatch-staging fix takes batch=1 itself to ~4k (ratio_live's
+    # denominator), and coalescing multiplies ~4x on top of that — so
+    # the honest decomposition is 20x total = ~5x (staging fix, every
+    # caller) x ~4x (coalescing, concurrent callers), and ratio_live
+    # alone UNDERSTATES the win over the pre-PR serving stack. The seed
+    # anchor is a FULL-shape measurement, so the ratio is skipped (None)
+    # on reduced shapes.
+    ratio_seed = (best_rps / SEED_BATCH1_ROWS_PER_SEC) if full else None
+
+    # -- load shed under 2x open-loop overload -----------------------------
+    # Poisson arrivals at 2x the measured single-row capacity against a
+    # bounded queue: the typed-rejection contract sheds the excess
+    # instead of queueing everyone into a latency cliff.
+    rng = np.random.default_rng(31)
+    n_over = 1024 if full else 512
+    over_frontend = ServingFrontend(
+        {"default": model}, ladder=ladder,
+        config=FrontendConfig(coalesce_window_s=0.002, max_pending=128))
+    # Warm every group size admission can form (up to max_pending=128
+    # pending -> a 128-row bucket): a compile inside the timed overload
+    # run would itself cause shedding and fake the latency cliff.
+    over_frontend.replay([singles[i % n_singles] for i in range(512)],
+                         concurrency=128)
+    arrivals = np.cumsum(rng.exponential(1.0 / (2.0 * best_rps), n_over))
+    reqs = [singles[i % n_singles] for i in range(n_over)]
+    telemetry.reset()
+    telemetry.enable()
+    _, info = over_frontend.replay(reqs, arrivals=arrivals)
+    over_lat = telemetry.histogram(
+        "serving.frontend.request_latency_seconds").snapshot()
+    telemetry.disable()
+    overload = {
+        "arrival_rate_req_per_sec": round(2.0 * best_rps, 1),
+        "max_pending": 128,
+        "requests": n_over,
+        "shed": info["shed"],
+        "shed_rate": round(info["shed"] / n_over, 4),
+        "completed_p50_ms": round(over_lat["p50"] * 1e3, 3)
+        if over_lat["p50"] is not None else None,
+        "completed_p99_ms": round(over_lat["p99"] * 1e3, 3)
+        if over_lat["p99"] is not None else None,
+    }
+
+    # -- heavy-tailed traffic: Zipf sizes, Poisson arrivals ----------------
+    n_ht = 512 if full else 256
+    sizes = np.minimum(rng.zipf(1.8, n_ht), 256)
+    starts = rng.integers(0, pool.num_rows - 256, n_ht)
+    ht_reqs = [pool.subset(np.arange(a, a + s))
+               for a, s in zip(starts, sizes)]
+    ht_rows = int(sizes.sum())
+    ht_frontend = ServingFrontend(
+        {"default": model}, ladder=ladder,
+        config=FrontendConfig(coalesce_window_s=0.002, max_pending=4096))
+    # Warm the full Zipf bucket population (same request list) so the
+    # timed pass measures serving, not XLA compiles — and time a second
+    # closed-loop pass as the CAPACITY estimate for this mix. Mixed-size
+    # capacity is well below single-row request capacity (big requests
+    # inflate the shared group's row/nnz buckets), so the open-loop
+    # arrival rate targets ~70% of the MEASURED mix capacity: the
+    # near-saturation regime where the latency tail comes from
+    # heavy-tailed SIZES (a 256-row request holds a window's worth of
+    # singles behind it), not from a standing overload queue.
+    ht_frontend.replay(ht_reqs, concurrency=16)
+    t0 = time.perf_counter()
+    ht_frontend.replay(ht_reqs, concurrency=16)
+    ht_capacity_rps = n_ht / (time.perf_counter() - t0)
+    ht_req_rate = 0.7 * ht_capacity_rps
+    ht_arrivals = np.cumsum(rng.exponential(1.0 / ht_req_rate, n_ht))
+    # One untimed pass with the SAME open-loop arrivals: transient
+    # backlogs coalesce into much larger groups than any closed-loop
+    # warm forms (hundreds of queued rows -> 1k/2k/4k-row buckets), and
+    # a cold bucket compile inside the timed pass would report as a
+    # fake ~600ms latency cliff.
+    ht_frontend.replay(ht_reqs, arrivals=ht_arrivals)
+    telemetry.reset()
+    telemetry.enable()
+    t0 = time.perf_counter()
+    _, ht_info = ht_frontend.replay(ht_reqs, arrivals=ht_arrivals)
+    ht_dt = time.perf_counter() - t0
+    ht_lat = telemetry.histogram(
+        "serving.frontend.request_latency_seconds").snapshot()
+    telemetry.disable()
+    heavy_tailed = {
+        "requests": n_ht,
+        "rows": ht_rows,
+        "closed_loop_capacity_req_per_sec": round(ht_capacity_rps, 1),
+        "arrival_rate_req_per_sec": round(ht_req_rate, 1),
+        "zipf_a": 1.8,
+        "size_cap": 256,
+        "max_request_rows": int(sizes.max()),
+        "rows_per_sec": round(ht_rows / ht_dt, 1),
+        "shed": ht_info["shed"],
+        "p50_ms": round(ht_lat["p50"] * 1e3, 3),
+        "p99_ms": round(ht_lat["p99"] * 1e3, 3),
+    }
+
+    # -- 2-model tenancy: shared cache, asserted compile bound -------------
+    model_b = _frontend_model_variant(model)
+    ten = ServingFrontend({"a": model, "b": model_b}, ladder=ladder,
+                          config=FrontendConfig(coalesce_window_s=0.0))
+    rng2 = np.random.default_rng(7)
+    t_sizes = rng2.integers(1, min(4096, pool.num_rows) + 1, 25)
+    t_reqs = []
+    for s in t_sizes:
+        a = int(rng2.integers(0, pool.num_rows - int(s) + 1))
+        t_reqs.append(pool.subset(np.arange(a, a + int(s))))
+    # concurrency 1 + window 0: every request dispatches solo, so the
+    # expected bucket population is exactly the per-request shapes.
+    ten.replay(t_reqs, model="a", concurrency=1)
+    ten.replay(t_reqs, model="b", concurrency=1)
+    eng_a = ten.engine("a")
+    expected = set()
+    for r in t_reqs:
+        nnz = tuple(int(r.feature_shards[s].nnz)
+                    for s in eng_a.shard_order)
+        expected.add(ladder.bucket_shape(r.num_rows, nnz))
+    try:
+        # Two same-structure resident models, ONE executable population:
+        # the bound is the SINGLE-model ladder expectation, not 2x.
+        ten.cache.assert_max_retraces(max_total=len(expected) + 1,
+                                      per_fn=1)
+        compile_bound_ok = True
+    except RetraceError:
+        compile_bound_ok = False
+    tenancy = {
+        "models": 2,
+        "requests_per_model": len(t_reqs),
+        "ladder_expected_buckets_per_model": len(expected),
+        "compilations": ten.cache.compilations,
+        "traces": ten.cache.total_traces(),
+        "compile_bound_ok": compile_bound_ok,
+    }
+
+    return {
+        "batch1_uncoalesced_rows_per_sec": round(base_rps, 1),
+        "seed_batch1_rows_per_sec": SEED_BATCH1_ROWS_PER_SEC,
+        "batched_dispatch_rows_per_sec": round(batched_rps, 1),
+        "sweep": cells,
+        "sweep_per_fn_trace_ok": sweep_per_fn_ok,
+        "best_concurrency64_cell": best_key,
+        "coalesced_c64_rows_per_sec": best_rps,
+        "coalesced_vs_batch1_ratio": round(ratio_live, 1),
+        "coalesced_vs_seed_batch1_ratio": (
+            round(ratio_seed, 1) if ratio_seed is not None else None),
+        "coalesced_frac_of_batched_dispatch": round(
+            best_rps / batched_rps, 3) if batched_rps else None,
+        "target_20x_met": (bool(ratio_seed >= 20.0)
+                           if ratio_seed is not None else None),
+        "overload_2x": overload,
+        "heavy_tailed": heavy_tailed,
+        "tenancy": tenancy,
+        "cpu_cores": cpu_cores,
+        "requests_per_cell": k_req,
+        "note": "single-row concurrent requests through the async "
+                "front-end (closed-loop requesters; end-to-end P50/P99 "
+                "incl. queue wait) vs sequential batch=1 engine.score; "
+                "the 20x target reads against the PR 2 seed baseline "
+                "(seed_batch1_rows_per_sec) because this PR's "
+                "dispatch-staging fix also moved the live batch=1 "
+                "denominator ~5x; 1-core host — event loop, featureize, "
+                "and XLA:CPU dispatch timeshare one core, so the curve "
+                "is an honest lower bound on the coalescing win; see "
+                "docs/SCALE.md §Serving front-end",
+    }
+
+
 def _stream_scoring_records(k, d_g, d_u, d_i, seed=29):
     """Streaming TrainingExampleAvro scoring-request generator: sparse
     global features plus small user/item feature rows, entity ids in
@@ -1898,6 +2212,7 @@ def main():
     score_rps, score_shape = _try(scoring_rows_per_sec,
                                   (float("nan"), "failed"))
     serving = _try(serving_bench, {"note": "failed"})
+    serving_frontend = _try(serving_frontend_bench, {"note": "failed"})
     stream_scoring = _try(stream_scoring_bench, {"note": "failed"})
     stream_training = _try(stream_training_bench, {"note": "failed"})
     # On a real chip run the live libtpu client holds the process lock
@@ -2014,6 +2329,7 @@ def main():
             "scoring_rows_per_sec": _round(score_rps, 1),
             "scoring_shape": score_shape,
             "serving": serving,
+            "serving_frontend": serving_frontend,
             "stream_scoring": stream_scoring,
             "stream_training": stream_training,
             "aot_v5e_cost": aot_cost,

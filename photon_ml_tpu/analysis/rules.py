@@ -421,12 +421,105 @@ class TelemetryInTraceRule:
         return None
 
 
+class BlockingInAsyncRule:
+    """The serving front-end's event loop IS the product: one blocking
+    call inside a coroutine stalls ADMISSION for every connected
+    requester — queue-wait spikes for traffic that never touched the
+    offending request. Blocking work belongs on the dispatch executor
+    thread (``run_in_executor``); waits belong to ``await``."""
+
+    id = "blocking-in-async"
+    doc = ("time.sleep / block_until_ready / no-timeout queue .get() "
+           "inside an async def body in serving/ — stalls the event "
+           "loop for every in-flight request")
+
+    #: Only the serving package hosts event-loop code; elsewhere a sync
+    #: sleep on a worker thread is legitimate pipeline behavior.
+    _DIRS = ("photon_ml_tpu/serving/",)
+
+    def check(self, mod: ModuleSource, project: Project) -> List[Violation]:
+        p = "/" + mod.path
+        if not any("/" + d in p for d in self._DIRS):
+            return []
+        out: List[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._in_async_body(mod, node):
+                continue
+            v = self._check_call(mod, node)
+            if v is not None:
+                out.append(v)
+        return out
+
+    @staticmethod
+    def _in_async_body(mod: ModuleSource, node: ast.AST) -> bool:
+        """Innermost enclosing real function (lambdas look through to
+        their definer — a lambda body runs wherever it is called, and
+        one defined in a coroutine usually runs there). EXCEPT a lambda
+        handed straight to ``run_in_executor``/``submit``: that body
+        runs on an executor thread where blocking is the whole point —
+        it is the remediation this rule's messages recommend."""
+        fi = mod.fn_of.get(node)
+        while fi is not None and isinstance(fi.node, ast.Lambda):
+            parent = mod.parents.get(fi.node)
+            if isinstance(parent, ast.Call) \
+                    and isinstance(parent.func, ast.Attribute) \
+                    and parent.func.attr in ("run_in_executor", "submit"):
+                return False
+            fi = fi.parent
+        return fi is not None and isinstance(fi.node, ast.AsyncFunctionDef)
+
+    def _check_call(self, mod: ModuleSource,
+                    call: ast.Call) -> Optional[Violation]:
+        # An awaited call yields to the loop by construction
+        # (await q.get() on an asyncio.Queue is the CORRECT pattern).
+        if isinstance(mod.parents.get(call), ast.Await):
+            return None
+        f = call.func
+        if isinstance(f, ast.Name) \
+                and mod.imports.get(f.id) == "time.sleep":
+            # 'from time import sleep' — same blocking call, bare name.
+            return mod.violation(
+                call, self.id,
+                "time.sleep() inside an async def blocks the whole "
+                "event loop (admission, coalescing, every pending "
+                "future) — use 'await asyncio.sleep(...)'")
+        if isinstance(f, ast.Attribute):
+            if f.attr == "sleep" and isinstance(f.value, ast.Name) \
+                    and mod.imports.get(f.value.id) == "time":
+                return mod.violation(
+                    call, self.id,
+                    "time.sleep() inside an async def blocks the whole "
+                    "event loop (admission, coalescing, every pending "
+                    "future) — use 'await asyncio.sleep(...)'")
+            if f.attr in ("block_until_ready", "device_get"):
+                return mod.violation(
+                    call, self.id,
+                    f".{f.attr}() inside an async def parks the event "
+                    "loop on device completion — dispatch on the "
+                    "executor thread (run_in_executor) and await the "
+                    "result instead")
+            if f.attr == "get" and not call.args \
+                    and not any(kw.arg == "timeout"
+                                for kw in call.keywords):
+                return mod.violation(
+                    call, self.id,
+                    "argument-less .get() inside an async def reads as "
+                    "a synchronous queue.get() that blocks the loop "
+                    "until an item arrives — use an asyncio.Queue "
+                    "('await q.get()'), or pass timeout= if this really "
+                    "is a thread-queue handoff")
+        return None
+
+
 ALL_RULES = (
     RetraceHazardRule(),
     HostSyncRule(),
     DtypeDriftRule(),
     NondeterministicPytreeRule(),
     TelemetryInTraceRule(),
+    BlockingInAsyncRule(),
 )
 
 RULE_IDS = tuple(r.id for r in ALL_RULES)

@@ -17,6 +17,16 @@ Two execution shapes:
   columns (score/label/offset/weight + entity-id strings) across the
   whole input — features never accumulate, but metric computation is
   O(total rows); omit evaluators to keep streaming strictly bounded.
+
+A third shape, ``--serve``, replays the input as CONCURRENT requests
+through the async serving front-end (photon_ml_tpu/serving/frontend.py):
+the decoded input is sliced into ``--request-rows``-row requests,
+``--serve-concurrency`` requesters submit them over an event loop, and
+the front-end coalesces whatever lands inside ``--coalesce-ms`` into
+shared bucket dispatches. Scores are identical to the other paths; what
+changes is the execution shape — this is the serving-traffic harness
+(admission control, queue-wait/coalesce telemetry, per-request P50/P99
+in metrics.json ``frontend``), see docs/SCALE.md §Serving front-end.
 """
 
 from __future__ import annotations
@@ -76,6 +86,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "background thread (0 = synchronous decode; "
                         "peak resident batches stay bounded by this "
                         "depth + 2)")
+    p.add_argument("--serve", action="store_true",
+                   help="replay the input as concurrent per-request "
+                        "traffic through the async serving front-end "
+                        "(request coalescing + admission control; "
+                        "mutually exclusive with --stream)")
+    p.add_argument("--serve-concurrency", type=int, default=16,
+                   help="concurrent closed-loop requesters in --serve "
+                        "mode")
+    p.add_argument("--coalesce-ms", type=float, default=2.0,
+                   help="--serve bounded coalesce window in "
+                        "milliseconds (0 = adaptive drain)")
+    p.add_argument("--request-rows", type=int, default=1,
+                   help="rows per replayed request in --serve mode "
+                        "(1 = the single-row serving shape)")
+    p.add_argument("--max-pending", type=int, default=1024,
+                   help="--serve admission bound (requests admitted and "
+                        "unfinished); raised to --serve-concurrency if "
+                        "lower, so the closed-loop replay never sheds")
     p.add_argument("--trace-out", default=None, metavar="PATH",
                    help="write a Chrome trace-event JSON of the run's "
                         "pipeline spans here (load in Perfetto — "
@@ -109,14 +137,19 @@ def _device_scores(model, data, logger):
     """Score a resident dataset on device; host-numpy fallback when a
     sub-model family is not device-scorable (same scores either way).
 
-    Only scorer CONSTRUCTION may trigger the fallback — that is where the
-    unsupported-sub-model TypeError contract lives; a TypeError out of the
-    scoring dispatch itself would be a real bug and must surface."""
+    The fallback is restricted to the DOCUMENTED contract — the typed
+    ``UnsupportedSubModelError`` the scorers raise at construction for a
+    sub-model family without a device kernel (or a snapshot past the
+    densification ceiling). A bare ``TypeError`` — from construction OR
+    dispatch — is a real engine bug and must surface instead of
+    silently degrading every score to the slow host path
+    (tests/test_cli_drivers.py::test_game_scoring_engine_bug_surfaces)."""
     from photon_ml_tpu.models.device_scoring import DeviceGameScorer
+    from photon_ml_tpu.serving.kernels import UnsupportedSubModelError
 
     try:
         scorer = DeviceGameScorer(model, data, dtype=_scoring_dtype())
-    except TypeError as e:
+    except UnsupportedSubModelError as e:
         logger.info("device scorer unavailable for this model (%s); "
                     "falling back to host numpy scoring", e)
         return model.score(data), "host"
@@ -216,7 +249,14 @@ def _run_scoring(args, out_dir, logger) -> dict:
         scores_dir.mkdir(exist_ok=True)
         scores_path = scores_dir / "part-00000.avro"
 
-    if args.stream:
+    if args.stream and args.serve:
+        raise SystemExit("--stream and --serve are mutually exclusive: "
+                         "--stream is the bounded-memory bulk path, "
+                         "--serve the concurrent-request replay harness")
+    if args.serve:
+        summary = _run_serve(args, inputs, id_types, shard_maps, model,
+                             evaluators, scores_path, logger)
+    elif args.stream:
         summary = _run_stream(args, inputs, id_types, shard_maps, model,
                               evaluators, scores_path, logger)
     else:
@@ -257,12 +297,17 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
     requested) accumulate across batches — never features — so metrics
     cost O(total rows) of scalars/id strings while feature memory stays
     O(batch_rows x (prefetch + pipeline depth))."""
-    from photon_ml_tpu.serving import StreamingGameScorer
+    from photon_ml_tpu.serving import (
+        StreamingGameScorer,
+        UnsupportedSubModelError,
+    )
 
     try:
         with span("setup_engine"):
             engine = StreamingGameScorer(model, dtype=_scoring_dtype())
-    except TypeError as e:
+    except UnsupportedSubModelError as e:
+        # Only the documented not-device-scorable contract exits cleanly;
+        # any other TypeError is an engine bug and propagates.
         raise SystemExit(
             f"--stream requires a device-scorable model: {e}") from e
 
@@ -314,6 +359,94 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
         "batch_rows": args.batch_rows,
         "feeder": scored.stream.stats(),
         "engine": engine.stats(),
+    }
+
+
+def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
+               scores_path, logger) -> dict:
+    """Concurrent-request replay through the async serving front-end:
+    the decoded input splits into ``--request-rows``-row requests,
+    ``--serve-concurrency`` closed-loop requesters submit them on an
+    event loop, and the front-end coalesces each ``--coalesce-ms``
+    window into shared bucket dispatches. Unlike --stream this harness
+    holds the decoded requests (and their scores) in memory — it
+    exercises the serving shape, not the bounded-memory one."""
+    from photon_ml_tpu.data.avro_reader import iter_game_dataset_batches
+    from photon_ml_tpu.evaluation.validation import StreamedEvalAccumulator
+    from photon_ml_tpu.serving import (
+        FrontendConfig,
+        ServingFrontend,
+        UnsupportedSubModelError,
+    )
+
+    if args.request_rows < 1:
+        raise SystemExit("--request-rows must be >= 1")
+    try:
+        with span("setup_engine"):
+            frontend = ServingFrontend(
+                {"default": model}, dtype=_scoring_dtype(),
+                config=FrontendConfig(
+                    coalesce_window_s=args.coalesce_ms / 1e3,
+                    max_pending=max(args.max_pending,
+                                    args.serve_concurrency)))
+    except UnsupportedSubModelError as e:
+        raise SystemExit(
+            f"--serve requires a device-scorable model: {e}") from e
+
+    with span("ingest"):
+        requests = []
+        for ds in iter_game_dataset_batches(
+                inputs, id_types=id_types, feature_shard_maps=shard_maps,
+                batch_rows=args.batch_rows, feeder=args.feeder,
+                prefetch_depth=args.prefetch_batches):
+            for a in range(0, ds.num_rows, args.request_rows):
+                requests.append(ds.subset(np.arange(
+                    a, min(a + args.request_rows, ds.num_rows))))
+    logger.info("serving replay: %d requests (%d rows each), "
+                "concurrency %d, coalesce window %.1f ms",
+                len(requests), args.request_rows, args.serve_concurrency,
+                args.coalesce_ms)
+
+    with span("score"):
+        results, info = frontend.replay(
+            requests, concurrency=args.serve_concurrency)
+    assert info["shed"] == 0, \
+        "closed-loop replay can never shed (max_pending >= concurrency)"
+    if info["errors"]:
+        raise SystemExit(
+            f"--serve: {info['errors']} requests failed "
+            "(see log; scores would be incomplete)")
+
+    acc = StreamedEvalAccumulator(id_types) if evaluators else None
+    counters = {"rows": 0}
+
+    def scored_records():
+        uid_base = 0
+        for ds, scores in zip(requests, results):
+            counters["rows"] += ds.num_rows
+            if acc is not None:
+                acc.add(ds, scores)
+            uids = ds.uids if ds.uids is not None else np.asarray(
+                [str(uid_base + i) for i in range(ds.num_rows)])
+            uid_base += ds.num_rows
+            for u, s, o, l in zip(uids, scores, ds.offsets, ds.responses):
+                yield {"uid": str(u), "predictionScore": float(s + o),
+                       "label": float(l), "metadataMap": None}
+
+    with span("write_scores"):
+        write_container(scores_path, schemas.SCORING_RESULT,
+                        scored_records())
+    with span("evaluate"):
+        metrics = acc.metrics(evaluators) if acc is not None else {}
+    return {
+        "num_rows": counters["rows"],
+        "metrics": metrics,
+        "scoring_path": "async-frontend",
+        "num_requests": len(requests),
+        "request_rows": args.request_rows,
+        "coalesce_window_ms": args.coalesce_ms,
+        "concurrency": args.serve_concurrency,
+        "frontend": frontend.stats(),
     }
 
 

@@ -151,7 +151,7 @@ class DeviceGameScorer:
                 self._sdata.append((feats, mapped))
                 self._static.append(None)
             else:
-                raise TypeError(
+                raise kernels.UnsupportedSubModelError(
                     f"coordinate {name!r}: cannot device-score "
                     f"{type(m).__name__}")
 

@@ -20,6 +20,12 @@ _EXPORTS = {
     "BucketLadder": "photon_ml_tpu.serving.buckets",
     "StreamingGameScorer": "photon_ml_tpu.serving.engine",
     "ExecutableCache": "photon_ml_tpu.serving.engine",
+    "ServingFrontend": "photon_ml_tpu.serving.frontend",
+    "FrontendConfig": "photon_ml_tpu.serving.frontend",
+    "FrontendError": "photon_ml_tpu.serving.frontend",
+    "RequestRejected": "photon_ml_tpu.serving.frontend",
+    "UnknownModelError": "photon_ml_tpu.serving.frontend",
+    "UnsupportedSubModelError": "photon_ml_tpu.serving.kernels",
 }
 
 __all__ = list(_EXPORTS)

@@ -146,6 +146,24 @@ class _HostRequest:
     codes: Tuple[Tuple[np.ndarray, ...], ...]  # per sub, per effect type
 
 
+def _vstack_csr(mats: List[sp.csr_matrix]) -> sp.csr_matrix:
+    """Row-stack same-width CSRs by direct triplet concatenation.
+    Equivalent to ``sp.vstack(mats, format="csr")`` but ~3x cheaper for
+    the coalescing shape (many tiny matrices): scipy's generic path
+    re-validates and re-converts each block, which dominates a
+    64-single-row group's assemble time."""
+    indptr_parts = [np.zeros(1, mats[0].indptr.dtype)]
+    off = 0
+    for m in mats:
+        indptr_parts.append(m.indptr[1:] + off)
+        off += m.nnz
+    return sp.csr_matrix(
+        (np.concatenate([m.data for m in mats]),
+         np.concatenate([m.indices for m in mats]),
+         np.concatenate(indptr_parts)),
+        shape=(sum(m.shape[0] for m in mats), mats[0].shape[1]))
+
+
 class StreamingGameScorer:
     """Scores arbitrary GameDatasets against ONE frozen GameModel.
 
@@ -157,7 +175,9 @@ class StreamingGameScorer:
     def __init__(self, model: GameModel, dtype=jnp.float32,
                  ladder: Optional[BucketLadder] = None,
                  pipeline_depth: int = 2,
-                 tracing_guard: Optional[TracingGuard] = None):
+                 tracing_guard: Optional[TracingGuard] = None,
+                 cache: Optional[ExecutableCache] = None,
+                 metrics_label: Optional[str] = None):
         self.dtype = np.dtype(jnp.dtype(dtype))
         self.ladder = ladder if ladder is not None else BucketLadder()
         self.pipeline_depth = max(1, pipeline_depth)
@@ -166,9 +186,38 @@ class StreamingGameScorer:
         self._shards: Dict[str, int] = {}  # shard id -> n_features
         self._stats = {"dispatches": 0, "requests": 0, "rows_scored": 0,
                        "rows_padded": 0, "nnz_scored": 0, "nnz_padded": 0}
+        # ``cache`` lets several engines share one executable population
+        # (multi-model tenancy — the front-end's registry passes its
+        # cache to every resident engine; keys carry the model structure
+        # INCLUDING parameter shapes, so same-structure models share
+        # executables and different-structure models can never collide).
         # ``tracing_guard`` lets callers (the pytest fixture, a serving
         # health check) own the retrace assertions; default = private.
-        self.cache = ExecutableCache(guard=tracing_guard)
+        if cache is not None and tracing_guard is not None \
+                and cache.guard is not tracing_guard:
+            raise ValueError("pass either a shared cache OR a "
+                             "tracing_guard, not both (the cache already "
+                             "owns a guard)")
+        self.cache = cache if cache is not None \
+            else ExecutableCache(guard=tracing_guard)
+        # Per-model registry metrics (serving.model.<label>.*): with
+        # several engines resident in one process the PROCESS-wide
+        # serving.* metrics sum across models, so a labeled engine
+        # additionally mirrors into its own metric family and stats()
+        # reports the per-model latency histogram instead of the global
+        # one (docs/OBSERVABILITY.md §Per-model metrics).
+        self.metrics_label = metrics_label
+        if metrics_label:
+            pre = f"serving.model.{metrics_label}."
+            self._m_requests = telemetry.counter(pre + "requests")
+            self._m_dispatches = telemetry.counter(pre + "dispatches")
+            self._m_rows_scored = telemetry.counter(pre + "rows_scored")
+            self._h_latency = telemetry.histogram(
+                pre + "request_latency_seconds")
+        else:
+            self._m_requests = self._m_dispatches = None
+            self._m_rows_scored = None
+            self._h_latency = None
 
         dt = jnp.dtype(dtype)
         for name, m in model.models.items():
@@ -229,13 +278,37 @@ class StreamingGameScorer:
                 self._params.append((jnp.asarray(m.row_factors, dt),
                                      jnp.asarray(m.col_factors, dt)))
             else:
-                raise TypeError(f"coordinate {name!r}: cannot device-score "
-                                f"{type(m).__name__}")
+                raise kernels.UnsupportedSubModelError(
+                    f"coordinate {name!r}: cannot device-score "
+                    f"{type(m).__name__}")
         self._params = tuple(self._params)
         self._shard_order = tuple(self._shards)
+        # Request-vocab join memo: coalesced serving traffic slices many
+        # requests from few backing datasets, and ``GameDataset.subset``
+        # SHARES the vocabulary array across slices — so the
+        # O(request_vocab log model_vocab) searchsorted join recomputes
+        # identically per request. Keyed by (sub, effect, id(vocab));
+        # each entry keeps a reference to its vocab array, so the id can
+        # never be recycled while the entry lives. Single-row request
+        # featureization drops ~4x with the join memoized (bench
+        # serving_frontend extra).
+        self._join_memo: Dict[Tuple[int, int, int],
+                              Tuple[np.ndarray, np.ndarray]] = {}
+        # Parameter SHAPES are part of the structure key: a cache shared
+        # across engines must never hand model A's executable to model B
+        # with differently-shaped params (same wrapped jax.jit would
+        # silently retrace, breaking the per_fn=1 guard bound); models
+        # whose shapes DO match share executables — params are traced
+        # arguments, so tenancy of N same-structure variants compiles
+        # one executable population, not N.
+        param_shapes = tuple(
+            tuple(tuple(a.shape) for a in p) if isinstance(p, tuple)
+            else tuple(p.shape)
+            for p in self._params)
         self._structure_key = (
             tuple((s.kind, s.shard_id, s.effect_types) for s in self._subs),
-            tuple(sorted(self._shards.items())), str(self.dtype))
+            tuple(sorted(self._shards.items())), param_shapes,
+            str(self.dtype))
 
     def _register_shard(self, name: str, shard_id: str, d: int) -> None:
         prev = self._shards.setdefault(shard_id, int(d))
@@ -263,15 +336,25 @@ class StreamingGameScorer:
                     f"model expects {d}")
             shards[sid] = csr
         codes = []
-        for spec in self._subs:
+        for i, spec in enumerate(self._subs):
             per_effect = []
-            for etype, vocab in zip(spec.effect_types, spec.vocabs):
+            for j, (etype, vocab) in enumerate(zip(spec.effect_types,
+                                                   spec.vocabs)):
                 col = data.id_columns.get(etype)
                 if col is None:
                     raise KeyError(
                         f"request is missing id column {etype!r} "
                         f"(has {sorted(data.id_columns)})")
-                lookup = vocab.codes_of(col.vocabulary).astype(np.int32)
+                memo_key = (i, j, id(col.vocabulary))
+                ent = self._join_memo.get(memo_key)
+                if ent is None or ent[0] is not col.vocabulary:
+                    if len(self._join_memo) >= 64:  # bound: serving
+                        self._join_memo.clear()     # sees few vocabs
+                    lookup = vocab.codes_of(
+                        col.vocabulary).astype(np.int32)
+                    self._join_memo[memo_key] = (col.vocabulary, lookup)
+                else:
+                    lookup = ent[1]
                 per_effect.append(lookup[col.codes])
             codes.append(tuple(per_effect))
         return _HostRequest(int(data.num_rows), shards, tuple(codes))
@@ -290,8 +373,7 @@ class StreamingGameScorer:
         nnz_total = 0
         for sid in self._shard_order:
             mats = [r.shards[sid] for r in group]
-            csr = mats[0] if len(mats) == 1 else sp.vstack(mats,
-                                                           format="csr")
+            csr = mats[0] if len(mats) == 1 else _vstack_csr(mats)
             nnz_b = self.ladder.nnz_bucket(csr.nnz, rows_b)
             shard_args.append(padded_csr_arrays(csr, rows_b, nnz_b,
                                                 value_dtype=self.dtype))
@@ -314,6 +396,9 @@ class StreamingGameScorer:
         self._stats["rows_scored"] += n_total
         _M_REQUESTS.inc(len(group))
         _M_ROWS_SCORED.inc(n_total)
+        if self._m_requests is not None:
+            self._m_requests.inc(len(group))
+            self._m_rows_scored.inc(n_total)
         self._stats["rows_padded"] += rows_b
         self._stats["nnz_scored"] += nnz_total
         self._stats["nnz_padded"] += sum(nnz_buckets)
@@ -346,6 +431,16 @@ class StreamingGameScorer:
 
         return jax.jit(score_bucket)
 
+    #: Above this per-batch upload size the dispatch stages arguments
+    #: through ``chunked_device_put`` (bounded-chunk H2D); below it the
+    #: jitted call's own C++ argument transfer wins outright — a 64-row
+    #: coalesced bucket is ~12 leaves of a few KB each, and per-leaf
+    #: python device_put was ~40% of the whole dispatch (bench
+    #: serving_frontend extra). The top serving bucket stays well under
+    #: this, so the chunked path is effectively the safety net for
+    #: unusually wide custom ladders.
+    DISPATCH_STAGE_BYTES = 64 << 20
+
     def _dispatch(self, key, host_args) -> Array:
         """Upload one padded batch and launch its bucket executable
         (async — the returned device array is a future; the ``dispatch``
@@ -354,11 +449,26 @@ class StreamingGameScorer:
         with span("dispatch"):
             fn = self.cache.get_or_build(
                 key, lambda: self._build_fn(*key[0]))
-            dev = jax.tree.map(lambda a: chunked_device_put(a), host_args,
-                               is_leaf=lambda x: isinstance(x, np.ndarray))
+            args = host_args
+            total = sum(a.nbytes for a in jax.tree.leaves(host_args))
+            if total > self.DISPATCH_STAGE_BYTES:
+                args = jax.tree.map(
+                    lambda a: chunked_device_put(a), host_args,
+                    is_leaf=lambda x: isinstance(x, np.ndarray))
             self._stats["dispatches"] += 1
             _M_DISPATCHES.inc()
-            return fn(*dev, self._params)
+            if self._m_dispatches is not None:
+                self._m_dispatches.inc()
+            return fn(*args, self._params)
+
+    def _observe_latency(self, seconds: float, n: int = 1) -> None:
+        """``n`` requests settled at one latency (a coalesced group
+        shares its dispatch wall time): feed the process-wide latency
+        histogram and, when this engine is labeled, its per-model twin —
+        one lock acquisition per GROUP, not per request."""
+        _H_REQUEST_LATENCY.observe(seconds, n=n)
+        if self._h_latency is not None:
+            self._h_latency.observe(seconds, n=n)
 
     # -- public scoring API ------------------------------------------------
 
@@ -413,7 +523,7 @@ class StreamingGameScorer:
                     host[:sum(datasets[i].num_rows for i in idxs)],
                     splits)):
                 results[idx] = chunk
-                _H_REQUEST_LATENCY.observe(lat)
+            self._observe_latency(lat, n=len(idxs))
 
         for g in groups:
             if len(g) == 1 and datasets[g[0]].num_rows \
@@ -447,7 +557,7 @@ class StreamingGameScorer:
             pending.append(np.asarray(out)[:n_real])
             if t_start is None:  # not the dataset's last piece
                 return None
-            _H_REQUEST_LATENCY.observe(time.perf_counter() - t_start)
+            self._observe_latency(time.perf_counter() - t_start)
             res = (pending[0] if len(pending) == 1
                    else np.concatenate(pending))
             pending.clear()
@@ -539,9 +649,12 @@ class StreamingGameScorer:
 
     def stats(self) -> dict:
         """Engine telemetry, snake_case schema (docs/OBSERVABILITY.md).
-        ``request_latency_seconds`` reads the PROCESS-wide serving
-        histogram (populated only while telemetry is enabled; count 0 /
-        None percentiles otherwise)."""
+        ``request_latency_seconds`` reads this engine's per-model
+        histogram when the engine was built with ``metrics_label`` (so
+        two resident models never cross-contaminate each other's
+        percentiles), else the PROCESS-wide serving histogram — which
+        sums across every live engine (populated only while telemetry is
+        enabled; count 0 / None percentiles otherwise)."""
         s = dict(self._stats)
         s["padding_waste_rows"] = (
             1.0 - s["rows_scored"] / s["rows_padded"]
@@ -550,5 +663,9 @@ class StreamingGameScorer:
             1.0 - s["nnz_scored"] / s["nnz_padded"]
             if s["nnz_padded"] else 0.0)
         s.update(self.cache_info())
-        s["request_latency_seconds"] = _H_REQUEST_LATENCY.snapshot()
+        if self.metrics_label:
+            s["metrics_label"] = self.metrics_label
+        h = self._h_latency if self._h_latency is not None \
+            else _H_REQUEST_LATENCY
+        s["request_latency_seconds"] = h.snapshot()
         return s

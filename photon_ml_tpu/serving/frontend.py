@@ -1,0 +1,538 @@
+"""Async serving front-end: cross-request coalescing, multi-model
+tenancy, and admission control in front of the streaming engine.
+
+``StreamingGameScorer`` micro-batches WITHIN one caller (score_many /
+score_stream); nothing coalesced ACROSS callers, so concurrent
+single-row traffic — the millions-of-users shape — paid one full bucket
+dispatch each (measured ~0.8k rows/s at batch=1 vs ~168k at batch=4096:
+almost all of it per-dispatch overhead, docs/SCALE.md §Serving). This
+module is the missing host-side aggregation tier (Snap ML's hierarchical
+host/accelerator split, PAPERS.md):
+
+- **coalescing**: in-flight requests arriving on the event loop are held
+  for a bounded wait window (``FrontendConfig.coalesce_window_s``,
+  default 2 ms; 0 = adaptive drain-whatever-queued) or until a full
+  bucket's worth of rows is queued, then packed into ONE pow-2 bucket
+  dispatch through the engine's ``score_many`` and scattered back
+  per-request. The window is the explicit tail-latency/throughput knob
+  (docs/SCALE.md §Serving front-end carries the measured curve).
+- **admission control**: at most ``max_pending`` requests may be
+  admitted-and-unfinished; past that ``score`` fails FAST with a typed
+  :class:`RequestRejected` (load-shed) instead of growing an unbounded
+  queue whose every entry would miss its deadline anyway.
+- **multi-model tenancy**: N frozen GAME models resident concurrently,
+  sharing one :class:`BucketLadder` and ONE :class:`ExecutableCache`
+  (keys carry bucket shape + model structure INCLUDING param shapes +
+  dtype, so same-structure A/B variants share executables and compile
+  counts stay bounded by the per-model ladder expectation — never
+  model count x buckets for structure twins). ``swap_model`` is atomic:
+  requests pin their engine at ADMISSION, so everything admitted before
+  the swap completes on the old weights, byte-identical to pre-swap
+  scoring, and nothing is ever dropped or misrouted.
+
+Blocking work never runs on the event loop (enforced by the jaxlint
+``blocking-in-async`` rule): device dispatch runs on a single dedicated
+executor thread, so the loop keeps admitting and coalescing window k+1
+while window k is on the device.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import dataclasses
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.serving.buckets import BucketLadder
+from photon_ml_tpu.serving.engine import ExecutableCache, StreamingGameScorer
+from photon_ml_tpu.telemetry import span
+from photon_ml_tpu.utils.tracing_guard import TracingGuard
+
+# Process-wide front-end metrics (no-ops while telemetry is off).
+# ``request_latency_seconds`` here is END-TO-END (admission -> settled
+# result, queue wait included) — the SLO number; the engine's
+# serving.request_latency_seconds starts at featureization and excludes
+# the queue (docs/OBSERVABILITY.md §Per-model metrics).
+_M_ADMITTED = telemetry.counter("serving.frontend.admitted")
+_M_REJECTED = telemetry.counter("serving.frontend.rejected")
+_M_COMPLETED = telemetry.counter("serving.frontend.completed")
+_M_GROUPS = telemetry.counter("serving.frontend.coalesced_groups")
+_M_SWAPS = telemetry.counter("serving.frontend.model_swaps")
+_H_QUEUE_WAIT = telemetry.histogram("serving.frontend.queue_wait_seconds")
+_H_LATENCY = telemetry.histogram(
+    "serving.frontend.request_latency_seconds")
+#: pow-2 buckets 1..4096 — group sizes quantize like the row ladder.
+_H_GROUP_REQUESTS = telemetry.histogram(
+    "serving.frontend.coalesce_group_requests",
+    buckets=tuple(float(1 << k) for k in range(13)))
+
+
+class FrontendError(RuntimeError):
+    """Base class for front-end contract violations."""
+
+
+class UnknownModelError(FrontendError):
+    """Request names a model that is not resident."""
+
+    def __init__(self, model: str, resident: Sequence[str]):
+        super().__init__(
+            f"unknown model {model!r} (resident: {sorted(resident)})")
+        self.model = model
+        self.resident = tuple(sorted(resident))
+
+
+class RequestRejected(FrontendError):
+    """Load-shed: admission control refused the request because
+    ``max_pending`` requests are already admitted and unfinished. The
+    typed rejection is the overload CONTRACT — callers retry elsewhere /
+    later instead of queueing into a latency cliff."""
+
+    def __init__(self, model: str, pending: int, limit: int):
+        super().__init__(
+            f"request for model {model!r} rejected: {pending} requests "
+            f"already pending >= max_pending={limit} (overload load-shed)")
+        self.model = model
+        self.pending = pending
+        self.limit = limit
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontendConfig:
+    """Admission + coalescing knobs.
+
+    - ``coalesce_window_s``: bounded wait after the first request of a
+      group arrives; everything admitted inside the window joins the
+      group. 0 disables the timer — the batcher drains whatever has
+      queued (adaptive batching: groups still form while a dispatch has
+      the executor busy).
+    - ``max_pending``: admission bound on admitted-and-unfinished
+      requests; beyond it ``score`` raises :class:`RequestRejected`.
+    - ``max_group_rows``: dispatch a group early once this many rows are
+      queued (default: the ladder's ``max_rows`` — a full top bucket;
+      waiting longer could not pack any denser).
+    """
+
+    coalesce_window_s: float = 0.002
+    max_pending: int = 1024
+    max_group_rows: Optional[int] = None
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One admitted request: engine pinned at admission (hot-swap can
+    never re-route it), future settled at scatter-back."""
+
+    data: object
+    model: str
+    engine: StreamingGameScorer
+    future: asyncio.Future
+    t_admit: float
+
+
+class ServingFrontend:
+    """Event-loop front door over N resident :class:`StreamingGameScorer`
+    engines. Construct with a ``{name: GameModel}`` mapping (or
+    ``add_model`` incrementally), then::
+
+        async with frontend:
+            scores = await frontend.score(request_ds, model="default")
+
+    or drive a whole request list through :meth:`replay` (which owns its
+    own event loop — the CLI ``--serve`` mode and the bench do this).
+
+    ``coalesce_window_s`` is re-read every cycle from the public
+    attribute, so operators (and the bench sweep) can retune the
+    latency/throughput trade-off on a live front-end without rebuilding
+    engines or dropping the warm executable cache.
+    """
+
+    def __init__(self, models: Optional[Dict[str, object]] = None,
+                 dtype=jnp.float32,
+                 ladder: Optional[BucketLadder] = None,
+                 config: Optional[FrontendConfig] = None,
+                 tracing_guard: Optional[TracingGuard] = None,
+                 pipeline_depth: int = 2):
+        self.config = config if config is not None else FrontendConfig()
+        self.ladder = ladder if ladder is not None else BucketLadder()
+        self.cache = ExecutableCache(guard=tracing_guard)
+        self.coalesce_window_s = float(self.config.coalesce_window_s)
+        self.max_group_rows = (self.config.max_group_rows
+                               if self.config.max_group_rows is not None
+                               else self.ladder.max_rows)
+        self._dtype = dtype
+        self._pipeline_depth = pipeline_depth
+        self._engines: Dict[str, StreamingGameScorer] = {}
+        self._stats = {"admitted": 0, "rejected": 0, "completed": 0,
+                       "failed": 0, "coalesced_groups": 0,
+                       "dispatch_groups": 0, "model_swaps": 0,
+                       "isolation_splits": 0}
+        self._pending = 0
+        self._queue: deque = deque()
+        self._queued_rows = 0
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._batcher_task: Optional[asyncio.Task] = None
+        self._dispatch_tasks: set = set()
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._closing = False
+        for name, model in (models or {}).items():
+            self.add_model(name, model)
+
+    # -- model registry ----------------------------------------------------
+
+    def _build_engine(self, name: str, model) -> StreamingGameScorer:
+        return StreamingGameScorer(
+            model, dtype=self._dtype, ladder=self.ladder,
+            pipeline_depth=self._pipeline_depth, cache=self.cache,
+            metrics_label=name)
+
+    def add_model(self, name: str, model) -> StreamingGameScorer:
+        """Upload ``model`` and make it routable as ``name``. Blocking
+        (uploads params) — call at startup or from a worker thread, not
+        from a coroutine on the serving loop."""
+        if name in self._engines:
+            raise FrontendError(
+                f"model {name!r} already resident; use swap_model")
+        eng = self._build_engine(name, model)
+        self._engines[name] = eng
+        return eng
+
+    def swap_model(self, name: str, model) -> StreamingGameScorer:
+        """Atomic hot-swap: build the replacement engine, then rebind the
+        name in one assignment. Requests pin their engine at ADMISSION,
+        so everything admitted before this call completes on the old
+        weights (byte-identical to pre-swap scoring) and everything after
+        routes to the new engine — no request is ever dropped, errored,
+        or scored on a half-swapped model. Returns the OLD engine (its
+        in-flight work keeps it alive regardless)."""
+        if name not in self._engines:
+            raise UnknownModelError(name, self._engines)
+        eng = self._build_engine(name, model)
+        old = self._engines[name]
+        self._engines[name] = eng  # atomic under the GIL
+        self._stats["model_swaps"] += 1
+        _M_SWAPS.inc()
+        return old
+
+    def remove_model(self, name: str) -> None:
+        """Stop routing ``name``; in-flight requests (engine pinned at
+        admission) still complete."""
+        if name not in self._engines:
+            raise UnknownModelError(name, self._engines)
+        del self._engines[name]
+
+    @property
+    def models(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._engines))
+
+    def engine(self, name: str) -> StreamingGameScorer:
+        eng = self._engines.get(name)
+        if eng is None:
+            raise UnknownModelError(name, self._engines)
+        return eng
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "ServingFrontend":
+        if self._batcher_task is not None:
+            raise FrontendError("frontend already started")
+        self._loop = asyncio.get_running_loop()
+        self._wake = asyncio.Event()
+        self._closing = False
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serving-dispatch")
+        self._batcher_task = self._loop.create_task(self._batch_loop())
+        return self
+
+    async def close(self) -> None:
+        """Drain: every admitted request settles before close returns."""
+        if self._batcher_task is None:
+            return
+        self._closing = True
+        self._wake.set()
+        await self._batcher_task
+        self._batcher_task = None
+        while self._dispatch_tasks:
+            await asyncio.gather(*list(self._dispatch_tasks))
+        self._pool.shutdown(wait=True)
+        self._pool = None
+
+    async def __aenter__(self) -> "ServingFrontend":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- request path ------------------------------------------------------
+
+    async def score(self, data, model: str = "default") -> np.ndarray:
+        """Admit one scoring request and await its result (host
+        f[n_rows], same contract as ``StreamingGameScorer.score``).
+        Raises :class:`RequestRejected` under overload and
+        :class:`UnknownModelError` for a non-resident model — both
+        BEFORE admission, so a rejected request costs microseconds."""
+        if self._batcher_task is None:
+            raise FrontendError("frontend not started (use 'async with' "
+                                "or await start())")
+        if self._closing:
+            # close() drains what was admitted BEFORE it; a request
+            # sneaking in after the batcher's final drain would never
+            # be grouped and would hang its caller forever.
+            raise FrontendError("frontend is closing; request refused")
+        engine = self._engines.get(model)
+        if engine is None:
+            raise UnknownModelError(model, self._engines)
+        if self._pending >= self.config.max_pending:
+            self._stats["rejected"] += 1
+            _M_REJECTED.inc()
+            raise RequestRejected(model, self._pending,
+                                  self.config.max_pending)
+        fut = self._loop.create_future()
+        p = _Pending(data, model, engine, fut, time.perf_counter())
+        self._pending += 1
+        # The registry twin of this counter is batch-incremented at
+        # group formation (one lock per group); the stats dict is the
+        # always-live per-admission view.
+        self._stats["admitted"] += 1
+        self._queue.append(p)
+        self._queued_rows += int(data.num_rows)
+        self._wake.set()
+        try:
+            return await fut
+        finally:
+            self._pending -= 1
+
+    # -- coalescing batcher ------------------------------------------------
+
+    async def _batch_loop(self) -> None:
+        while True:
+            if not self._queue:
+                if self._closing:
+                    return
+                await self._wake.wait()
+                self._wake.clear()
+                continue
+            window = self.coalesce_window_s
+            if window > 0 and self._queued_rows < self.max_group_rows \
+                    and not self._closing:
+                # Bounded wait: requests admitted inside the window join
+                # this group; a full top bucket's worth of rows ends the
+                # wait early (waiting longer could not pack denser).
+                # Never a blocking sleep — the loop keeps admitting
+                # (jaxlint blocking-in-async enforces this stays true).
+                await self._sleep_or_full(window)
+            self._form_groups()
+
+    async def _sleep_or_full(self, window: float) -> None:
+        deadline = time.perf_counter() + window
+        while True:
+            remain = deadline - time.perf_counter()
+            if remain <= 0 or self._queued_rows >= self.max_group_rows \
+                    or self._closing:
+                return
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), remain)
+            except asyncio.TimeoutError:
+                return
+
+    def _form_groups(self) -> None:
+        """Drain the queue into per-engine groups (arrival order kept
+        within each) and launch one dispatch task per group. Pure
+        synchronous event-loop work — the span is honest."""
+        with span("coalesce"):
+            group = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+            if telemetry.enabled():
+                # One vectorized observation + one counter batch per
+                # GROUP (not per request): at 64-way coalescing the
+                # per-request lock round-trips were a measurable slice
+                # of the event-loop budget.
+                now = time.perf_counter()
+                _H_QUEUE_WAIT.observe_many(
+                    [now - p.t_admit for p in group])
+                _M_ADMITTED.inc(len(group))
+            _H_GROUP_REQUESTS.observe(len(group))
+            self._stats["coalesced_groups"] += 1
+            _M_GROUPS.inc()
+            parts: Dict[int, List[_Pending]] = {}
+            order: List[int] = []
+            for p in group:
+                key = id(p.engine)
+                if key not in parts:
+                    parts[key] = []
+                    order.append(key)
+                parts[key].append(p)
+            for key in order:
+                items = parts[key]
+                self._stats["dispatch_groups"] += 1
+                task = self._loop.create_task(self._dispatch_group(items))
+                self._dispatch_tasks.add(task)
+                task.add_done_callback(self._dispatch_tasks.discard)
+
+    def _score_group(self, engine: StreamingGameScorer,
+                     datasets: List) -> List:
+        """Executor-thread body: one coalesced ``score_many`` pass;
+        per-request (result, error) pairs. A malformed request must not
+        poison the callers it happened to share a window with, so a
+        failing group retries per-request and only the offender errors
+        (fault isolation; counted in ``isolation_splits``).
+
+        Known trade-off: if the window spanned SEVERAL engine dispatch
+        groups and a later group failed, the retry re-scores requests
+        whose group already dispatched — their results stay correct,
+        but the engine's requests/rows_scored counters over-count them
+        on this (rare, error-path-only) branch. ``score_many`` discards
+        partials on failure, so avoiding it would mean re-implementing
+        the engine's packing here; not worth it for an error path."""
+        try:
+            return [(r, None) for r in engine.score_many(datasets)]
+        except Exception:  # noqa: BLE001 — isolate, then re-raise solo
+            if len(datasets) == 1:
+                raise
+        self._stats["isolation_splits"] += 1
+        out = []
+        for ds in datasets:
+            try:
+                out.append((engine.score_many([ds])[0], None))
+            except Exception as e:  # noqa: BLE001 — per-request verdict
+                out.append((None, e))
+        return out
+
+    async def _dispatch_group(self, items: List[_Pending]) -> None:
+        engine = items[0].engine
+        datasets = [p.data for p in items]
+        try:
+            results = await self._loop.run_in_executor(
+                self._pool, self._score_group, engine, datasets)
+        except Exception as e:  # noqa: BLE001 — fail the whole group
+            results = [(None, e)] * len(items)
+        with span("scatter"):
+            now = time.perf_counter()
+            lats: List[float] = []
+            for p, (res, err) in zip(items, results):
+                if p.future.done():  # caller cancelled; nothing to route
+                    continue
+                if err is None:
+                    p.future.set_result(res)
+                    self._stats["completed"] += 1
+                    lats.append(now - p.t_admit)
+                else:
+                    p.future.set_exception(err)
+                    self._stats["failed"] += 1
+            if lats:  # one locked batch per settled group
+                _M_COMPLETED.inc(len(lats))
+                _H_LATENCY.observe_many(lats)
+
+    # -- replay harness ----------------------------------------------------
+
+    def replay(self, requests: Sequence, model: str = "default",
+               concurrency: int = 16,
+               arrivals: Optional[Sequence[float]] = None):
+        """Drive ``requests`` through the front-end on a private event
+        loop; returns ``(results, info)`` with ``results[i]`` the score
+        vector of ``requests[i]`` (``None`` where load-shed).
+
+        Closed-loop by default: ``concurrency`` requester coroutines each
+        submit the next un-taken request as soon as their previous one
+        settles — the steady-state serving shape. With ``arrivals``
+        (seconds, per request) submission is OPEN-loop at those offsets
+        regardless of completions — the overload / load-shed shape.
+        """
+        return asyncio.run(self._replay(requests, model, concurrency,
+                                        arrivals))
+
+    async def _replay(self, requests, model, concurrency, arrivals):
+        async with self:
+            results: List[Optional[np.ndarray]] = [None] * len(requests)
+            info = {"requests": len(requests), "shed": 0, "errors": 0}
+
+            async def run_one(i: int) -> None:
+                try:
+                    results[i] = await self.score(requests[i], model=model)
+                except RequestRejected:
+                    info["shed"] += 1
+                except FrontendError:
+                    raise
+                except Exception:  # noqa: BLE001 — count, keep serving
+                    info["errors"] += 1
+
+            if arrivals is None:
+                it = iter(range(len(requests)))
+
+                async def worker() -> None:
+                    # run_one's body inlined: one coroutine frame per
+                    # REQUEST is pure overhead at single-row coalescing
+                    # rates (the whole request is ~tens of µs of loop
+                    # work).
+                    score = self.score
+                    for i in it:
+                        try:
+                            results[i] = await score(requests[i],
+                                                     model=model)
+                        except RequestRejected:
+                            info["shed"] += 1
+                        except FrontendError:
+                            raise
+                        except Exception:  # noqa: BLE001 — keep serving
+                            info["errors"] += 1
+
+                n = max(1, min(int(concurrency), len(requests) or 1))
+                await asyncio.gather(*[worker() for _ in range(n)])
+            else:
+                if len(arrivals) != len(requests):
+                    raise ValueError(
+                        f"arrivals ({len(arrivals)}) must match requests "
+                        f"({len(requests)})")
+
+                async def submit(i: int, at: float) -> None:
+                    await asyncio.sleep(at)
+                    await run_one(i)
+
+                await asyncio.gather(
+                    *[submit(i, float(a))
+                      for i, a in enumerate(arrivals)])
+            info["completed"] = sum(r is not None for r in results)
+            return results, info
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Front-end telemetry snapshot (snake_case,
+        docs/OBSERVABILITY.md). Local counters are always live;
+        histogram percentiles populate only while telemetry is enabled.
+        ``engines`` nests each resident model's per-engine stats (their
+        ``request_latency_seconds`` is per-model — engine-side, queue
+        wait excluded; the front-end's own is end-to-end).
+
+        The ``serving.frontend.*`` histograms are PROCESS-wide — the
+        front-end is the process's one front door (tenancy lives in the
+        model registry, not in multiple front-ends), so per-instance
+        labeling à la ``metrics_label`` is deliberately not provided.
+        A process that really runs several instances (the bench does,
+        serially) must ``telemetry.reset()`` between them or accept
+        summed percentiles here; the dict counters above are
+        per-instance either way."""
+        return {
+            "models": list(self.models),
+            **dict(self._stats),
+            "pending": self._pending,
+            "max_pending": self.config.max_pending,
+            "coalesce_window_s": self.coalesce_window_s,
+            "max_group_rows": self.max_group_rows,
+            "queue_wait_seconds": _H_QUEUE_WAIT.snapshot(),
+            "request_latency_seconds": _H_LATENCY.snapshot(),
+            "coalesce_group_requests": _H_GROUP_REQUESTS.snapshot(),
+            "cache": {"entries": len(self.cache),
+                      "compilations": self.cache.compilations,
+                      "traces": self.cache.total_traces()},
+            "engines": {name: eng.stats()
+                        for name, eng in sorted(self._engines.items())},
+        }

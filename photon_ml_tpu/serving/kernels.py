@@ -34,6 +34,19 @@ from photon_ml_tpu.ops.features import CSRFeatures
 Array = jax.Array
 
 
+class UnsupportedSubModelError(TypeError):
+    """A GAME coordinate's sub-model family has no device scoring kernel
+    (or would be unreasonable to device-score, e.g. a snapshot past the
+    densification ceiling).
+
+    This is the ONE constructor-time condition the scoring driver may
+    turn into a host-numpy fallback; any other ``TypeError`` out of a
+    scorer is a real bug and must surface (the driver used to catch bare
+    ``TypeError``, which masked engine bugs as silent degradations —
+    tests/test_cli_drivers.py::test_game_scoring_engine_bug_surfaces).
+    Subclasses ``TypeError`` so pre-existing callers keep working."""
+
+
 def is_re_snapshot(m) -> bool:
     """Duck-typed io.model_io.RandomEffectModelSnapshot check, shared by
     both scorers (kept import-free: the IO layer consumes the scorers'
@@ -51,13 +64,13 @@ SNAPSHOT_DENSIFY_MAX_BYTES = 2 << 30
 
 
 def check_snapshot_densifiable(m, dtype) -> None:
-    """Raise TypeError (the scorers' constructor-time 'not device-scorable'
-    contract, which drivers turn into a host fallback) when densifying a
-    snapshot's entity matrix would be unreasonable."""
+    """Raise UnsupportedSubModelError (the scorers' constructor-time 'not
+    device-scorable' contract, which drivers turn into a host fallback)
+    when densifying a snapshot's entity matrix would be unreasonable."""
     nbytes = (len(m.vocabulary) + 1) * m.matrix.shape[1] \
         * np.dtype(dtype).itemsize
     if nbytes > SNAPSHOT_DENSIFY_MAX_BYTES:
-        raise TypeError(
+        raise UnsupportedSubModelError(
             f"random-effect snapshot {m.random_effect_type!r} would "
             f"densify to {nbytes / 1e9:.1f} GB "
             f"({len(m.vocabulary)} entities x {m.matrix.shape[1]} global "

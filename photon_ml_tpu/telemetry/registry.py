@@ -28,6 +28,8 @@ import bisect
 import threading
 from typing import Dict, Optional, Sequence, Tuple
 
+import numpy as np
+
 # One switch for the whole telemetry layer (metrics AND spans — spans.py
 # imports this module's accessors). Mutations early-return when off.
 _enabled = False
@@ -131,8 +133,8 @@ class Histogram:
     the default buckets). Empty histograms return None.
     """
 
-    __slots__ = ("name", "_bounds", "_counts", "_count", "_sum", "_min",
-                 "_max", "_lock")
+    __slots__ = ("name", "_bounds", "_bounds_arr", "_counts", "_count",
+                 "_sum", "_min", "_max", "_lock")
 
     def __init__(self, name: str,
                  buckets: Optional[Sequence[float]] = None):
@@ -142,6 +144,7 @@ class Histogram:
         if not bounds:
             raise ValueError("histogram needs at least one bucket bound")
         self._bounds = bounds
+        self._bounds_arr = np.asarray(bounds, dtype=float)
         # counts[i] covers (bounds[i-1], bounds[i]]; counts[len(bounds)]
         # is the overflow bucket (bounds[-1], +inf).
         self._counts = [0] * (len(bounds) + 1)
@@ -151,19 +154,45 @@ class Histogram:
         self._max = None
         self._lock = threading.Lock()
 
-    def observe(self, value) -> None:
+    def observe(self, value, n: int = 1) -> None:
+        """Record ``value`` (``n`` times — a coalesced dispatch settles a
+        whole group at one latency, so the serving hot path takes the
+        lock once per GROUP, not once per request)."""
         if not _enabled:
             return
         v = float(value)
         with self._lock:
             i = bisect.bisect_left(self._bounds, v)
-            self._counts[i] += 1
-            self._count += 1
-            self._sum += v
+            self._counts[i] += n
+            self._count += n
+            self._sum += v * n
             if self._min is None or v < self._min:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+
+    def observe_many(self, values) -> None:
+        """Vectorized ``observe`` for per-request samples that DIFFER
+        within a settled group (queue waits, end-to-end latencies): one
+        searchsorted + one lock acquisition for the whole batch instead
+        of a locked bisect per sample."""
+        if not _enabled:
+            return
+        v = np.asarray(values, dtype=float).ravel()
+        if v.size == 0:
+            return
+        idx = np.searchsorted(self._bounds_arr, v, side="left")
+        binned = np.bincount(idx, minlength=len(self._counts))
+        lo, hi = float(v.min()), float(v.max())
+        with self._lock:
+            for i in np.nonzero(binned)[0]:
+                self._counts[i] += int(binned[i])
+            self._count += int(v.size)
+            self._sum += float(v.sum())
+            if self._min is None or lo < self._min:
+                self._min = lo
+            if self._max is None or hi > self._max:
+                self._max = hi
 
     @property
     def count(self) -> int:

@@ -284,14 +284,16 @@ def test_game_scoring_stream_matches_batch(tmp_path, rng):
 
 def test_game_scoring_host_fallback_on_unsupported_model(
         tmp_path, rng, monkeypatch):
-    """A model family the device scorer rejects must fall back to host
-    numpy scoring, not crash the driver."""
+    """A model family the device scorer rejects — the TYPED
+    UnsupportedSubModelError contract — must fall back to host numpy
+    scoring, not crash the driver."""
     model_dir, valid = _train_small_game(tmp_path, rng, n_train=200,
                                          n_valid=60)
     from photon_ml_tpu.models import device_scoring
+    from photon_ml_tpu.serving.kernels import UnsupportedSubModelError
 
     def boom(*a, **kw):
-        raise TypeError("synthetic: unsupported sub-model")
+        raise UnsupportedSubModelError("synthetic: unsupported sub-model")
 
     monkeypatch.setattr(device_scoring, "DeviceGameScorer", boom)
     out = tmp_path / "score-fallback"
@@ -304,6 +306,81 @@ def test_game_scoring_host_fallback_on_unsupported_model(
     assert summary["numRows"] == 60
     assert summary["scoringPath"] == "host"
     assert (out / "scores" / "part-00000.avro").exists()
+
+
+def test_game_scoring_engine_bug_surfaces(tmp_path, rng, monkeypatch):
+    """Satellite regression: the host fallback is RESTRICTED to the
+    documented unsupported-sub-model case — an injected bare TypeError
+    out of the engine (a real bug) must surface, never silently degrade
+    to host scoring."""
+    model_dir, valid = _train_small_game(tmp_path, rng, n_train=200,
+                                         n_valid=60)
+    from photon_ml_tpu.models import device_scoring
+
+    def boom(*a, **kw):
+        raise TypeError("synthetic: engine bug, not the documented "
+                        "unsupported-sub-model contract")
+
+    monkeypatch.setattr(device_scoring, "DeviceGameScorer", boom)
+    with pytest.raises(TypeError, match="engine bug"):
+        game_scoring_driver.run([
+            "--input-dirs", str(valid),
+            "--game-model-input-dir", str(model_dir),
+            "--output-dir", str(tmp_path / "score-bug"),
+        ])
+
+
+def test_game_scoring_serve_matches_batch(tmp_path, rng):
+    """Tier-1 smoke for the async front-end CLI mode: --serve replays
+    the input as concurrent coalesced requests (python feeder, so it
+    runs everywhere) and must reproduce the one-shot scores exactly, in
+    order, with the frontend telemetry block in metrics.json."""
+    model_dir, valid = _train_small_game(tmp_path, rng)
+
+    batch_out = tmp_path / "score-batch"
+    batch = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(model_dir),
+        "--output-dir", str(batch_out),
+        "--evaluators", "AUC",
+    ])
+    serve_out = tmp_path / "score-serve"
+    serve = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(model_dir),
+        "--output-dir", str(serve_out),
+        "--evaluators", "AUC",
+        "--serve", "--request-rows", "7", "--serve-concurrency", "8",
+        "--coalesce-ms", "1", "--feeder", "python",
+    ])
+    assert serve["num_rows"] == batch["numRows"] == 140
+    assert serve["scoring_path"] == "async-frontend"
+    assert serve["num_requests"] == 20  # ceil(140 / 7)
+    np.testing.assert_allclose(serve["metrics"]["AUC"],
+                               batch["metrics"]["AUC"], atol=1e-9)
+    recs_b = list(read_container(batch_out / "scores" / "part-00000.avro"))
+    recs_s = list(read_container(serve_out / "scores" / "part-00000.avro"))
+    assert [r["uid"] for r in recs_s] == [r["uid"] for r in recs_b]
+    np.testing.assert_allclose(
+        [r["predictionScore"] for r in recs_s],
+        [r["predictionScore"] for r in recs_b], rtol=1e-9, atol=1e-12)
+    fe = serve["frontend"]
+    assert fe["admitted"] == fe["completed"] == 20
+    assert fe["rejected"] == 0
+    assert fe["engines"]["default"]["requests"] == 20
+    # coalescing happened: fewer device dispatches than requests
+    assert fe["engines"]["default"]["dispatches"] <= 20
+    # per-request latency telemetry populated (driver enables telemetry)
+    assert fe["request_latency_seconds"]["count"] == 20
+    assert fe["queue_wait_seconds"]["count"] == 20
+
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        game_scoring_driver.run([
+            "--input-dirs", str(valid),
+            "--game-model-input-dir", str(model_dir),
+            "--output-dir", str(tmp_path / "score-both"),
+            "--serve", "--stream",
+        ])
 
 
 def test_game_training_grid_selects_best(tmp_path, rng):

@@ -478,7 +478,8 @@ def test_every_rule_has_an_id_and_doc():
 
     assert sorted(RULE_IDS) == sorted({
         "retrace-hazard", "host-sync", "dtype-drift",
-        "nondeterministic-pytree", "telemetry-in-trace"})
+        "nondeterministic-pytree", "telemetry-in-trace",
+        "blocking-in-async"})
     for rule in ALL_RULES:
         assert rule.doc and rule.id
 
@@ -562,6 +563,114 @@ def f(x):
     return x + span(1)
 '''})
     assert vs == []
+
+
+# -- blocking-in-async -----------------------------------------------------
+
+def test_blocking_in_async_flags_sleep_sync_get_and_block():
+    vs = analyze_sources({"photon_ml_tpu/serving/f.py": '''
+import queue
+import time
+
+q = queue.Queue()
+
+
+async def batcher(x):
+    time.sleep(0.002)
+    item = q.get()
+    x.block_until_ready()
+    return item
+'''})
+    assert rules_of(vs) == ["blocking-in-async"] * 3
+    assert "event loop" in vs[0].message
+    assert "asyncio.Queue" in vs[1].message
+    assert "run_in_executor" in vs[2].message
+
+
+def test_blocking_in_async_flags_from_import_sleep():
+    """'from time import sleep' is the same blocking call under a bare
+    name — the attribute-form match alone must not be bypassable."""
+    vs = analyze_sources({"photon_ml_tpu/serving/f.py": '''
+from time import sleep
+
+
+async def batcher():
+    sleep(0.002)
+'''})
+    assert rules_of(vs) == ["blocking-in-async"]
+    # ...while a local function that HAPPENS to be called sleep is fine
+    vs = analyze_sources({"photon_ml_tpu/serving/f.py": '''
+def sleep(dt):
+    return dt
+
+
+async def batcher():
+    sleep(0.002)
+'''})
+    assert vs == []
+
+
+def test_blocking_in_async_accepts_awaits_timeouts_and_sync_defs():
+    """await asyncio.sleep / awaited queue gets / timeout= handoffs are
+    the correct patterns; sync defs (executor-thread bodies) and
+    dict.get(key) must not trip the rule."""
+    vs = analyze_sources({"photon_ml_tpu/serving/f.py": '''
+import asyncio
+import queue
+import time
+
+q = queue.Queue()
+aq = asyncio.Queue()
+
+
+async def batcher(cfg):
+    await asyncio.sleep(0.002)
+    item = await aq.get()
+    handoff = q.get(timeout=1.0)
+    window = cfg.get("window", 0.002)  # dict lookup, not a queue
+    return item, handoff, window
+
+
+def executor_body(x):
+    time.sleep(0.002)  # sync def: runs on a worker thread, may block
+    return q.get()
+'''})
+    assert vs == []
+
+
+def test_blocking_in_async_executor_lambda_is_exempt():
+    """The rule's own recommended remediation — a blocking body handed
+    to run_in_executor/submit — must not be flagged; a lambda merely
+    DEFINED in the coroutine (called inline) still is."""
+    vs = analyze_sources({"photon_ml_tpu/serving/f.py": '''
+import asyncio
+
+
+async def dispatch(loop, pool, out):
+    await loop.run_in_executor(None, lambda: out.block_until_ready())
+    pool.submit(lambda: out.block_until_ready())
+'''})
+    assert vs == []
+    vs = analyze_sources({"photon_ml_tpu/serving/f.py": '''
+async def dispatch(out):
+    wait = lambda: out.block_until_ready()
+    return wait()
+'''})
+    assert rules_of(vs) == ["blocking-in-async"]
+
+
+def test_blocking_in_async_scoped_to_serving():
+    src = '''
+import time
+
+
+async def poll():
+    time.sleep(0.01)
+'''
+    assert rules_of(analyze_sources(
+        {"photon_ml_tpu/serving/f.py": src})) == ["blocking-in-async"]
+    # outside serving/ there is no event-loop contract to protect
+    assert analyze_sources({"photon_ml_tpu/data/f.py": src}) == []
 
 
 # -- the actual tree is clean ----------------------------------------------

@@ -1,0 +1,394 @@
+"""Async serving front-end (photon_ml_tpu/serving/frontend.py):
+cross-request coalescing parity, admission-control contract, multi-model
+tenancy over one shared executable cache, and atomic hot-swap. The
+ENGINE semantics (bucketing, padding isolation, kernels) are covered by
+test_serving.py; under test here is the front door: the event-loop
+request path and the model registry."""
+
+import asyncio
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    LogisticRegressionModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serving import (
+    BucketLadder,
+    FrontendConfig,
+    FrontendError,
+    RequestRejected,
+    ServingFrontend,
+    StreamingGameScorer,
+    UnknownModelError,
+)
+from photon_ml_tpu.types import TaskType
+
+DT = jnp.float64
+
+LADDER = dict(min_rows=8, max_rows=64)
+
+
+def _dataset(rng, n=60, d=6, n_users=7, n_items=5):
+    x = rng.normal(0, 1, (n, d))
+    x[:, -1] = 1.0
+    users = rng.integers(0, n_users, n).astype(str)
+    items = rng.integers(0, n_items, n).astype(str)
+    user_x = sp.csr_matrix(np.hstack(
+        [rng.normal(0, 1, (n, 2)), np.ones((n, 1))]))
+    return GameDataset.build(
+        responses=(rng.random(n) < 0.5).astype(float),
+        feature_shards={"global": sp.csr_matrix(x), "user": user_x},
+        ids={"userId": users, "itemId": items})
+
+
+def _game_model(rng, train):
+    ds = build_random_effect_dataset(
+        train, RandomEffectDataConfiguration("userId", "user"),
+        intercept_col=2)
+    re = RandomEffectModel.zeros_like_dataset(ds, dtype=DT)
+    re = re.with_coefs([jnp.asarray(rng.normal(0, 1, np.asarray(c).shape))
+                        for c in re.local_coefs])
+    fe = FixedEffectModel(
+        LogisticRegressionModel(Coefficients(
+            jnp.asarray(rng.normal(0, 1, 6)))), "global")
+    mf = MatrixFactorizationModel(
+        "userId", "itemId",
+        jnp.asarray(rng.normal(0, 1, (7, 3))),
+        jnp.asarray(rng.normal(0, 1, (5, 3))),
+        np.unique(train.id_columns["userId"].vocabulary),
+        np.unique(train.id_columns["itemId"].vocabulary))
+    return GameModel({"fixed": fe, "perUser": re, "mf": mf},
+                     TaskType.LOGISTIC_REGRESSION)
+
+
+def _variant(model: GameModel, factor: float) -> GameModel:
+    """Same-STRUCTURE weight variant (the A/B tenancy shape): every
+    coordinate keeps its shapes/vocabs, fixed-effect weights scale."""
+    fe = model.models["fixed"]
+    glm = type(fe.glm)(Coefficients(
+        jnp.asarray(fe.glm.coefficients.means) * factor))
+    return model.update_model("fixed", FixedEffectModel(
+        glm, fe.feature_shard_id))
+
+
+@pytest.fixture
+def frontend_and_model(rng):
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    fe = ServingFrontend({"default": gm}, dtype=DT,
+                         ladder=BucketLadder(**LADDER),
+                         config=FrontendConfig(coalesce_window_s=0.001,
+                                               max_pending=256))
+    return fe, gm
+
+
+def _singles(seed0, k, n=1):
+    return [_dataset(np.random.default_rng(seed0 + i), n=n)
+            for i in range(k)]
+
+
+# -- coalescing parity -----------------------------------------------------
+
+@pytest.mark.needs_f64
+def test_concurrent_singles_coalesce_and_match_host(frontend_and_model):
+    fe, gm = frontend_and_model
+    reqs = _singles(100, 40)
+    results, info = fe.replay(reqs, concurrency=8)
+    assert info["shed"] == 0 and info["errors"] == 0
+    for r, o in zip(reqs, results):
+        np.testing.assert_allclose(o, gm.score(r), rtol=1e-10, atol=1e-10)
+    st = fe.stats()
+    # Coalescing genuinely happened: far fewer device dispatches than
+    # requests (8 concurrent requesters, 1 ms window).
+    assert st["engines"]["default"]["dispatches"] < len(reqs)
+    assert st["engines"]["default"]["requests"] == len(reqs)
+    assert st["completed"] == len(reqs) and st["admitted"] == len(reqs)
+
+
+@pytest.mark.needs_f64
+def test_full_window_coalesces_to_one_dispatch(frontend_and_model):
+    """All requests inside one (generous) window and under max_rows must
+    share ONE bucket dispatch."""
+    fe, gm = frontend_and_model
+    fe.coalesce_window_s = 0.25
+    reqs = _singles(200, 16)
+    results, _ = fe.replay(reqs, arrivals=[0.0] * len(reqs))
+    for r, o in zip(reqs, results):
+        np.testing.assert_allclose(o, gm.score(r), rtol=1e-10, atol=1e-10)
+    st = fe.stats()
+    assert st["engines"]["default"]["dispatches"] == 1
+    assert st["coalesced_groups"] == 1
+
+
+@pytest.mark.needs_f64
+def test_zero_row_and_oversized_requests(frontend_and_model):
+    """BucketLadder edges through the front door: a zero-row request
+    settles empty without a dispatch; a request beyond the top bucket
+    splits inside the engine and still matches host scoring."""
+    fe, gm = frontend_and_model
+    big = _dataset(np.random.default_rng(7), n=150)  # > max_rows=64
+    zero = _dataset(np.random.default_rng(8), n=20).subset(np.arange(0))
+    results, info = fe.replay([big, zero], concurrency=2)
+    assert info["shed"] == 0 and info["errors"] == 0
+    np.testing.assert_allclose(results[0], gm.score(big),
+                               rtol=1e-10, atol=1e-10)
+    assert results[1].shape == (0,)
+
+
+@pytest.mark.needs_f64
+def test_bad_request_is_isolated_from_its_window(frontend_and_model):
+    """A malformed request must error ALONE: the requests it was
+    coalesced with still score (the group retries per-request)."""
+    fe, gm = frontend_and_model
+    fe.coalesce_window_s = 0.25
+    good = _singles(300, 6)
+    bad = GameDataset.build(
+        responses=np.zeros(1),
+        feature_shards={"global": sp.csr_matrix(np.ones((1, 6)))},
+        ids={})  # missing 'user' shard and id columns
+
+    async def run():
+        async with fe:
+            tasks = [asyncio.ensure_future(fe.score(r))
+                     for r in good[:3] + [bad] + good[3:]]
+            return await asyncio.gather(*tasks, return_exceptions=True)
+
+    out = asyncio.run(run())
+    assert isinstance(out[3], KeyError)
+    for r, o in zip(good, out[:3] + out[4:]):
+        np.testing.assert_allclose(o, gm.score(r), rtol=1e-10, atol=1e-10)
+    assert fe.stats()["isolation_splits"] == 1
+    assert fe.stats()["failed"] == 1
+
+
+# -- admission control -----------------------------------------------------
+
+@pytest.mark.needs_f64
+def test_queue_full_rejection_contract(rng):
+    """Past max_pending, score() raises a TYPED rejection immediately
+    (fields: model/pending/limit); admitted requests still complete."""
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    fe = ServingFrontend({"default": gm}, dtype=DT,
+                         ladder=BucketLadder(**LADDER),
+                         config=FrontendConfig(coalesce_window_s=0.1,
+                                               max_pending=4))
+    reqs = _singles(400, 32)
+    results, info = fe.replay(reqs, arrivals=[0.0] * len(reqs))
+    # All 32 submit inside the window: exactly max_pending admitted.
+    assert info["completed"] == 4 and info["shed"] == 28
+    st = fe.stats()
+    assert st["rejected"] == 28 and st["admitted"] == 4
+    done = [r for r in results if r is not None]
+    assert len(done) == 4
+
+    async def one_reject():
+        async with fe:
+            tasks = [asyncio.ensure_future(fe.score(r))
+                     for r in reqs[:4]]
+            await asyncio.sleep(0)  # admit the four
+            with pytest.raises(RequestRejected) as ei:
+                await fe.score(reqs[4])
+            assert ei.value.model == "default"
+            assert ei.value.pending == 4 and ei.value.limit == 4
+            await asyncio.gather(*tasks)
+
+    asyncio.run(one_reject())
+
+
+@pytest.mark.needs_f64
+def test_unknown_model_and_not_started(frontend_and_model):
+    fe, _ = frontend_and_model
+    req = _singles(500, 1)[0]
+
+    async def unknown():
+        async with fe:
+            with pytest.raises(UnknownModelError):
+                await fe.score(req, model="nope")
+
+    asyncio.run(unknown())
+    with pytest.raises(FrontendError, match="not started"):
+        asyncio.run(fe.score(req))
+
+
+def test_score_during_close_is_refused_not_hung(frontend_and_model):
+    """close() drains what was admitted before it; a request admitted
+    after the batcher's final drain would never be grouped — score()
+    must refuse with a typed error instead of hanging its caller."""
+    fe, _ = frontend_and_model
+    req = _singles(500, 1)[0]
+
+    async def run():
+        await fe.start()
+        first = await fe.score(req)  # normal request settles
+        closer = asyncio.ensure_future(fe.close())
+        await asyncio.sleep(0)  # close() sets _closing, starts draining
+        with pytest.raises(FrontendError, match="closing"):
+            await fe.score(req)
+        await closer
+        return first
+
+    assert asyncio.run(run()).shape == (1,)
+
+
+# -- multi-model tenancy ---------------------------------------------------
+
+@pytest.mark.needs_f64
+def test_tenancy_routes_models_and_shares_executables(rng, tracing_guard):
+    """Two same-structure models resident: requests route to the right
+    weights, and the SHARED cache compiles one executable population —
+    bounded by the single-model ladder expectation, never
+    models x buckets."""
+    train = _dataset(rng, n=80)
+    gm_a = _game_model(rng, train)
+    gm_b = _variant(gm_a, 3.0)
+    fe = ServingFrontend({"a": gm_a, "b": gm_b}, dtype=DT,
+                         ladder=BucketLadder(**LADDER),
+                         tracing_guard=tracing_guard,
+                         config=FrontendConfig(coalesce_window_s=0.002))
+    sizes = [1, 3, 9, 17, 33, 2, 5]
+    reqs = [_dataset(np.random.default_rng(600 + i), n=k)
+            for i, k in enumerate(sizes)]
+
+    async def run():
+        async with fe:
+            ta = [asyncio.ensure_future(fe.score(r, model="a"))
+                  for r in reqs]
+            tb = [asyncio.ensure_future(fe.score(r, model="b"))
+                  for r in reqs]
+            return (await asyncio.gather(*ta), await asyncio.gather(*tb))
+
+    outs_a, outs_b = asyncio.run(run())
+    for r, oa, ob in zip(reqs, outs_a, outs_b):
+        np.testing.assert_allclose(oa, gm_a.score(r),
+                                   rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(ob, gm_b.score(r),
+                                   rtol=1e-10, atol=1e-10)
+        # the variant genuinely scores differently (no misrouting both
+        # ways onto one model)
+        assert not np.allclose(oa, ob)
+    # Shared-cache compile math: both engines' buckets land in ONE
+    # population; same structure (param shapes included in the key) ==
+    # shared executables, asserted through the tracing guard.
+    eng_a = fe.engine("a")
+    expected = set()
+    for r in reqs:
+        nnz = tuple(int(r.feature_shards[s].nnz)
+                    for s in eng_a.shard_order)
+        expected.add(fe.ladder.bucket_shape(r.num_rows, nnz))
+    assert fe.cache.compilations <= len(expected) + 1
+    fe.cache.assert_max_retraces(max_total=len(expected) + 1, per_fn=1)
+    tracing_guard.set_budget(len(expected) + 1)
+
+
+@pytest.mark.needs_f64
+def test_per_model_metrics_do_not_cross_contaminate(rng):
+    """Satellite: with two resident models, each engine's stats() reads
+    its OWN serving.model.<name>.request_latency_seconds — model a's
+    percentiles never fold in model b's observations (the process-wide
+    histogram still sums both, documented split)."""
+    train = _dataset(rng, n=80)
+    gm_a = _game_model(rng, train)
+    gm_b = _variant(gm_a, 2.0)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        fe = ServingFrontend({"a": gm_a, "b": gm_b}, dtype=DT,
+                             ladder=BucketLadder(**LADDER))
+        reqs = _singles(700, 6)
+        fe.replay(reqs[:4], model="a", concurrency=2)
+        fe.replay(reqs[4:], model="b", concurrency=2)
+        st = fe.stats()
+        assert st["engines"]["a"]["metrics_label"] == "a"
+        assert st["engines"]["a"]["request_latency_seconds"]["count"] == 4
+        assert st["engines"]["b"]["request_latency_seconds"]["count"] == 2
+        snap = telemetry.snapshot()
+        assert snap["counters"]["serving.model.a.requests"] == 4
+        assert snap["counters"]["serving.model.b.requests"] == 2
+        # process-wide histogram is the sum of both models
+        assert snap["histograms"]["serving.request_latency_seconds"][
+            "count"] == 6
+        # the front-end's end-to-end histogram covers every request too
+        assert snap["histograms"][
+            "serving.frontend.request_latency_seconds"]["count"] == 6
+        assert snap["histograms"][
+            "serving.frontend.queue_wait_seconds"]["count"] == 6
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# -- hot swap --------------------------------------------------------------
+
+@pytest.mark.needs_f64
+def test_hot_swap_never_drops_and_pins_old_weights(rng):
+    """The hot-swap contract: requests admitted BEFORE the swap complete
+    on the old weights, byte-identical to pre-swap scoring; requests
+    after the swap score on the new weights; nothing drops or errors."""
+    train = _dataset(rng, n=80)
+    gm_a = _game_model(rng, train)
+    gm_b = _variant(gm_a, 5.0)
+    ladder = BucketLadder(**LADDER)
+    fe = ServingFrontend({"m": gm_a}, dtype=DT, ladder=ladder,
+                         config=FrontendConfig(coalesce_window_s=0.0))
+    req = _dataset(np.random.default_rng(42), n=3)
+    # Reference engines at the SAME ladder: solo requests land in the
+    # same bucket shapes, so bitwise identity is well-defined.
+    ref_a = StreamingGameScorer(gm_a, dtype=DT, ladder=ladder)
+    ref_b = StreamingGameScorer(gm_b, dtype=DT, ladder=ladder)
+    bytes_a = ref_a.score(req).tobytes()
+    bytes_b = ref_b.score(req).tobytes()
+    assert bytes_a != bytes_b
+
+    async def run():
+        async with fe:
+            pre = await fe.score(req, model="m")
+            # Admit in-flight work, THEN swap before the batcher runs:
+            # the pinned engine must keep routing it to the old weights.
+            inflight = [asyncio.ensure_future(fe.score(req, model="m"))
+                        for _ in range(3)]
+            await asyncio.sleep(0)  # admission happens; no dispatch yet
+            old = fe.swap_model("m", gm_b)
+            during = await asyncio.gather(*inflight)
+            post = await fe.score(req, model="m")
+            return pre, during, post, old
+
+    pre, during, post, old = asyncio.run(run())
+    assert pre.tobytes() == bytes_a
+    for d in during:  # admitted pre-swap: old weights, byte-identical
+        assert d.tobytes() == bytes_a
+    assert post.tobytes() == bytes_b
+    st = fe.stats()
+    assert st["model_swaps"] == 1
+    assert st["admitted"] == st["completed"] == 5  # zero drops
+    assert st["failed"] == 0
+    # the displaced engine still carries its in-flight accounting
+    assert old.stats()["requests"] == 4
+
+
+@pytest.mark.needs_f64
+def test_swap_unknown_model_and_duplicate_add(frontend_and_model):
+    fe, gm = frontend_and_model
+    with pytest.raises(UnknownModelError):
+        fe.swap_model("ghost", gm)
+    with pytest.raises(FrontendError, match="already resident"):
+        fe.add_model("default", gm)
+    fe.remove_model("default")
+    assert fe.models == ()
+    with pytest.raises(UnknownModelError):
+        fe.remove_model("default")

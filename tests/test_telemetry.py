@@ -114,6 +114,42 @@ def test_histogram_percentiles_bounded_by_bucket_width():
     assert h.quantile(0.5) <= h.quantile(0.95) <= h.quantile(0.99)
 
 
+def test_histogram_batched_observe_matches_individual():
+    """observe(v, n=k) and observe_many(values) are locking/allocation
+    optimizations for the serving hot path — the resulting histogram
+    state must be IDENTICAL to the equivalent individual observes
+    (docs/OBSERVABILITY.md §Histogram semantics)."""
+    _on()
+    values = [0.3, 1.0, 1.0, 2.5, 7.0, 7.0, 7.0, 40.0, 0.05]
+    ref = Histogram("t.batch.ref", buckets=[1.0, 10.0, 100.0])
+    for v in values:
+        ref.observe(v)
+
+    many = Histogram("t.batch.many", buckets=[1.0, 10.0, 100.0])
+    many.observe_many(values)
+    assert many._counts == ref._counts
+    assert many.count == ref.count
+    assert many.snapshot() == ref.snapshot()
+
+    n_style = Histogram("t.batch.n", buckets=[1.0, 10.0, 100.0])
+    n_style.observe(0.3)
+    n_style.observe(1.0, n=2)   # boundary value: le semantics w/ n
+    n_style.observe(2.5)
+    n_style.observe(7.0, n=3)
+    n_style.observe(40.0)
+    n_style.observe(0.05)
+    assert n_style._counts == ref._counts
+    assert n_style.snapshot() == ref.snapshot()
+
+    # empty batch is a no-op, and disabled batches stay no-ops
+    many.observe_many([])
+    assert many.count == ref.count
+    telemetry.disable()
+    many.observe_many([1.0, 2.0])
+    many.observe(1.0, n=5)
+    assert many.count == ref.count
+
+
 def test_histogram_quantile_validates_range():
     h = Histogram("t.range")
     with pytest.raises(ValueError):
