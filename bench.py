@@ -1350,6 +1350,241 @@ def serving_frontend_bench():
     }
 
 
+def observability_bench():
+    """Cost of the live observability plane (PR 9,
+    photon_ml_tpu/telemetry/{exposition,recorder,slo}.py) against the
+    serving_frontend workload: P50 /metrics render time at a realistic
+    registry population, the rows/s delta of the coalesced closed-loop
+    workload with a 1 Hz scraper + flight recorder attached, the
+    recorder-absent disabled-path overhead estimate against the same 2%
+    gate PR 6's span instrumentation met, and an induced overload
+    asserting the shed-rate SLO's burn counters move the right way.
+    1-core host: scraper, event loop and dispatch timeshare one core, so
+    the scrape delta is an honest UPPER bound on the scrape cost."""
+    import threading
+    import urllib.request
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.algorithm import CoordinateDescent
+    from photon_ml_tpu.serving import (
+        BucketLadder,
+        FrontendConfig,
+        ServingFrontend,
+    )
+    from photon_ml_tpu.telemetry import (
+        FlightRecorder,
+        ObservabilityServer,
+        SLOTracker,
+        render_prometheus,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    try:
+        cpu_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cpu_cores = os.cpu_count() or 1
+
+    full = SHAPE_SCALE == "full"
+    data = build_problem()
+    cd = CoordinateDescent(build_coords(data, full_game=True),
+                           TaskType.LOGISTIC_REGRESSION)
+    model = cd.run(num_iterations=1).model
+    pool = _serving_request_pool(4_000, D_FIXED, N_USERS, D_USER,
+                                 N_ITEMS, D_ITEM)
+    ladder = BucketLadder(min_rows=16, max_rows=4096)
+    n_singles = 256
+    singles = [pool.subset(np.arange(i, i + 1)) for i in range(n_singles)]
+    k_req = 4096 if full else 1024
+    frontend = ServingFrontend(
+        {"default": model}, ladder=ladder,
+        config=FrontendConfig(coalesce_window_s=0.001, max_pending=4096))
+    reqs = [singles[i % n_singles] for i in range(k_req)]
+    frontend.replay(reqs[:512], concurrency=64)  # warm all group buckets
+
+    def run_workload():
+        t0 = time.perf_counter()
+        _, info = frontend.replay(reqs, concurrency=64)
+        assert info["shed"] == 0 and info["errors"] == 0
+        return k_req / (time.perf_counter() - t0)
+
+    # -- baseline: telemetry ENABLED (the plane requires it), no plane --
+    telemetry.reset()
+    telemetry.enable()
+    base_rps = 0.0
+    try:
+        for _ in range(2):  # best-of-2: 1-core timing noise
+            base_rps = max(base_rps, run_workload())
+        span_calls = sum(v["count"] for v in
+                         telemetry.stage_attribution().values())
+        mutation_calls = telemetry.registry().mutation_calls()
+        run_seconds = k_req / base_rps
+
+        # -- /metrics render cost at this registry population ----------
+        text = render_prometheus()
+        n_render = 200 if full else 50
+        times = []
+        for _ in range(n_render):
+            t0 = time.perf_counter()
+            render_prometheus()
+            times.append(time.perf_counter() - t0)
+        render_p50_ms = float(np.percentile(times, 50) * 1e3)
+
+        # -- plane attached: flight recorder + server + 1 Hz scraper ---
+        rec = FlightRecorder(max_events=4096).install()
+        srv = ObservabilityServer(port=0, recorder=rec).start()
+        stop = threading.Event()
+        scrapes = {"n": 0}
+
+        def scraper():
+            while not stop.wait(1.0):  # the ops-standard 1 Hz scrape
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics",
+                    timeout=5).read()
+                scrapes["n"] += 1
+
+        th = threading.Thread(target=scraper, daemon=True)
+        th.start()
+        try:
+            scraped_rps = 0.0
+            for _ in range(2):
+                scraped_rps = max(scraped_rps, run_workload())
+            # at least one scrape must land inside the measured window
+            # on slow hosts; force one for the cost books either way
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read()
+            scrapes["n"] += 1
+        finally:
+            stop.set()
+            th.join(timeout=5)
+            srv.stop()
+
+        # -- recorder-installed span cost (the per-span append) --------
+        n_cal = 100_000
+        with telemetry.span("cal_parent"):
+            t0 = time.perf_counter()
+            for _ in range(n_cal):
+                with telemetry.span("cal_rec"):
+                    pass
+            rec_span_ns = (time.perf_counter() - t0) / n_cal * 1e9
+        rec.uninstall()
+        with telemetry.span("cal_parent"):
+            t0 = time.perf_counter()
+            for _ in range(n_cal):
+                with telemetry.span("cal_norec"):
+                    pass
+            norec_span_ns = (time.perf_counter() - t0) / n_cal * 1e9
+        recorder_overhead_est = (span_calls
+                                 * max(0.0, rec_span_ns - norec_span_ns)
+                                 * 1e-9 / run_seconds)
+    finally:
+        telemetry.disable()
+
+    # -- disabled path: no telemetry, no recorder, no server -----------
+    # (the production default; the acceptance gate). Overhead estimate
+    # = observed call count x measured no-op cost / runtime, the PR 6
+    # methodology — there is no uninstrumented binary to diff against.
+    dis_rps = 0.0
+    for _ in range(2):
+        dis_rps = max(dis_rps, run_workload())
+    n_cal = 200_000
+    noop_counter = telemetry.counter("bench.noop")
+    t0 = time.perf_counter()
+    for _ in range(n_cal):
+        with telemetry.span("bench_noop"):
+            pass
+    noop_span_ns = (time.perf_counter() - t0) / n_cal * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n_cal):
+        noop_counter.inc()
+    noop_inc_ns = (time.perf_counter() - t0) / n_cal * 1e9
+    disabled_overhead = ((span_calls * noop_span_ns
+                          + mutation_calls * noop_inc_ns)
+                         * 1e-9 / (k_req / dis_rps))
+
+    # -- SLO burn under induced overload -------------------------------
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        tracker = SLOTracker(
+            ["shed=ratio:serving.frontend.rejected/"
+             "serving.frontend.admitted+serving.frontend.rejected"
+             "<=0.05"])
+        over = ServingFrontend(
+            {"default": model}, ladder=ladder,
+            config=FrontendConfig(coalesce_window_s=0.002,
+                                  max_pending=64))
+        over.replay(reqs[:256], concurrency=64)  # warm, no shed
+        before = tracker.evaluate()["shed"]
+        rng = np.random.default_rng(17)
+        n_over = 1024 if full else 512
+        arrivals = np.cumsum(rng.exponential(
+            1.0 / (2.0 * base_rps), n_over))  # 2x measured capacity
+        _, info = over.replay(
+            [singles[i % n_singles] for i in range(n_over)],
+            arrivals=arrivals)
+        after = tracker.evaluate()["shed"]
+        slo_overload = {
+            "objective": "shed-rate <= 5%",
+            "arrival_rate_x_capacity": 2.0,
+            "shed": info["shed"],
+            "shed_rate": round(info["shed"] / n_over, 4),
+            "burn_before": before["burn_rate"],
+            "burn_after": after["burn_rate"],
+            "violations_before": before["violations"],
+            "violations_after": after["violations"],
+            # correct = compliant (or no-traffic) before, burning > 1
+            # with a recorded violation after the overload
+            "burn_moved_correctly": bool(
+                before["compliant"] and after["burn_rate"] is not None
+                and after["burn_rate"] > 1.0
+                and after["violations"] == before["violations"] + 1),
+        }
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+    return {
+        "metrics_render": {
+            "families_bytes": len(text),
+            "p50_ms": round(render_p50_ms, 3),
+            "iters": n_render,
+        },
+        "scrape_cost": {
+            "scraper_hz": 1.0,
+            "baseline_rows_per_sec": round(base_rps, 1),
+            "scraped_rows_per_sec": round(scraped_rps, 1),
+            "delta_frac": round(1.0 - scraped_rps / base_rps, 4),
+            "scrapes_during_run": scrapes["n"],
+        },
+        "recorder": {
+            "span_with_recorder_ns": round(rec_span_ns, 1),
+            "span_without_recorder_ns": round(norec_span_ns, 1),
+            "installed_overhead_frac_est": round(recorder_overhead_est,
+                                                 6),
+        },
+        "disabled_path": {
+            "rows_per_sec": round(dis_rps, 1),
+            "span_calls": span_calls,
+            "mutation_calls": mutation_calls,
+            "noop_span_ns": round(noop_span_ns, 1),
+            "noop_mutation_ns": round(noop_inc_ns, 1),
+            "overhead_frac_est": round(disabled_overhead, 6),
+            "under_2pct_gate": bool(disabled_overhead < 0.02),
+        },
+        "slo_overload": slo_overload,
+        "requests": k_req,
+        "cpu_cores": cpu_cores,
+        "note": "closed-loop coalesced single-row serving workload "
+                "(64-way, 1 ms window); baseline/scraped/disabled are "
+                "best-of-2 on the SAME warm frontend. On this "
+                f"{cpu_cores}-core host the scraper steals cycles from "
+                "the event loop, so delta_frac upper-bounds the scrape "
+                "cost; the disabled-path estimate is the PR 6 "
+                "call-count x no-op-cost methodology against the 2% "
+                "gate (docs/OBSERVABILITY.md §Bench integration)",
+    }
+
+
 def _stream_scoring_records(k, d_g, d_u, d_i, seed=29):
     """Streaming TrainingExampleAvro scoring-request generator: sparse
     global features plus small user/item feature rows, entity ids in
@@ -2213,6 +2448,7 @@ def main():
                                   (float("nan"), "failed"))
     serving = _try(serving_bench, {"note": "failed"})
     serving_frontend = _try(serving_frontend_bench, {"note": "failed"})
+    observability = _try(observability_bench, {"note": "failed"})
     stream_scoring = _try(stream_scoring_bench, {"note": "failed"})
     stream_training = _try(stream_training_bench, {"note": "failed"})
     # On a real chip run the live libtpu client holds the process lock
@@ -2330,6 +2566,7 @@ def main():
             "scoring_shape": score_shape,
             "serving": serving,
             "serving_frontend": serving_frontend,
+            "observability": observability,
             "stream_scoring": stream_scoring,
             "stream_training": stream_training,
             "aot_v5e_cost": aot_cost,

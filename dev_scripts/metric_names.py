@@ -1,0 +1,180 @@
+#!/usr/bin/env python3
+"""Metric-name schema lint: every literal name handed to the telemetry
+factories (``counter(...)``/``gauge(...)``/``histogram(...)``) must be
+dotted snake_case (docs/OBSERVABILITY.md §Prometheus naming), and a name
+must never be registered as two different metric types.
+
+The registry is get-or-create by NAME with no type check across call
+sites — ``counter("x")`` in one module and ``gauge("x")`` in another
+would silently coexist as two metrics whose exposition families collide
+— and the Prometheus mapping (telemetry/exposition.py) sanitizes
+characters outside ``[a-zA-Z0-9_:]``, so a camelCase or hyphenated name
+would silently diverge from the documented ``dots -> underscores``
+mapping dashboards are built against. This gate keeps both invariants
+static, like jaxlint keeps the tracing invariants.
+
+Scope and mechanics:
+
+- AST walk of ``photon_ml_tpu/`` + ``bench.py`` (tests are EXEMPT: the
+  exposition tests deliberately register schema-violating names to
+  exercise escaping).
+- A call counts as a registration when it is ``<anything>.counter(...)``
+  / ``.gauge(...)`` / ``.histogram(...)`` (the ``telemetry.X`` /
+  ``registry().X`` forms) or a bare name imported from
+  ``photon_ml_tpu.telemetry``.
+- A fully-literal first argument (string constant, or a constant-only
+  concatenation) is schema-checked whole:
+  ``segment(.segment)*`` with each segment ``[a-z][a-z0-9_]*``.
+- A PARTIALLY literal argument (f-string or concatenation with a
+  variable — the per-model ``serving.model.<label>.*`` family) has its
+  literal fragments checked for illegal characters (uppercase or
+  anything outside ``[a-z0-9_.]``); the dynamic parts are runtime
+  values the lint cannot see.
+
+Exit 0 = clean. Run via tests.sh or directly:
+    python dev_scripts/metric_names.py [--root DIR] [paths...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from pathlib import Path
+
+FACTORIES = ("counter", "gauge", "histogram")
+DEFAULT_PATHS = ["photon_ml_tpu", "bench.py"]
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)*$")
+_FRAGMENT_BAD_RE = re.compile(r"[^a-z0-9_.]")
+
+
+def _telemetry_bare_names(tree: ast.AST) -> set:
+    """Factory names imported directly from the telemetry package
+    (``from photon_ml_tpu.telemetry import counter``)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.startswith("photon_ml_tpu.telemetry"):
+            for a in node.names:
+                if a.name in FACTORIES:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _literal_parts(node):
+    """(fragments, fully_literal) for a metric-name argument: the string
+    fragments statically present, and whether they cover the WHOLE
+    name. Handles plain constants, ``a + b`` concatenation chains, and
+    f-strings; anything else contributes an opaque dynamic part."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            return [node.value], True
+        return [], False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        lf, lfull = _literal_parts(node.left)
+        rf, rfull = _literal_parts(node.right)
+        return lf + rf, lfull and rfull
+    if isinstance(node, ast.JoinedStr):
+        frags = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                frags.append(v.value)
+        return frags, False
+    return [], False
+
+
+def check_file(path: Path, src: str, registrations: dict) -> list:
+    """Violations in one file; literal registrations accumulate into
+    ``registrations`` (name -> {kind: first location}) for the
+    cross-file conflicting-type check."""
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "syntax",
+                 f"does not parse: {e.msg}")]
+    bare = _telemetry_bare_names(tree)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in FACTORIES:
+            kind = fn.attr
+        elif isinstance(fn, ast.Name) and fn.id in bare:
+            kind = fn.id
+        else:
+            continue
+        frags, full = _literal_parts(node.args[0])
+        if not frags:
+            continue  # fully dynamic: runtime's problem
+        if full:
+            name = "".join(frags)
+            if not _NAME_RE.match(name):
+                out.append((path, node.lineno, "metric-name-schema",
+                            f"{kind}({name!r}): metric names are dotted "
+                            "snake_case — segment(.segment)*, each "
+                            "[a-z][a-z0-9_]* (docs/OBSERVABILITY.md)"))
+            else:
+                prev = registrations.setdefault(name, {})
+                prev.setdefault(kind, (path, node.lineno))
+        else:
+            for frag in frags:
+                m = _FRAGMENT_BAD_RE.search(frag)
+                if m:
+                    out.append((
+                        path, node.lineno, "metric-name-schema",
+                        f"{kind}(...{frag!r}...): literal fragment "
+                        f"contains {m.group(0)!r} — metric names are "
+                        "lowercase [a-z0-9_.] only"))
+                    break
+    return out
+
+
+def conflicting_types(registrations: dict) -> list:
+    out = []
+    for name, kinds in sorted(registrations.items()):
+        if len(kinds) > 1:
+            where = ", ".join(
+                f"{kind} at {p}:{ln}"
+                for kind, (p, ln) in sorted(kinds.items()))
+            out.append((Path("-"), 0, "metric-type-conflict",
+                        f"{name!r} registered as multiple metric types: "
+                        f"{where}"))
+    return out
+
+
+def iter_py_files(root: Path, paths):
+    for raw in paths:
+        p = root / raw
+        if p.is_file():
+            yield p
+        else:
+            yield from sorted(p.rglob("*.py"))
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--root", default=".",
+                    help="tree root (for tests against tmp trees)")
+    args = ap.parse_args(argv)
+    root = Path(args.root)
+    paths = args.paths or DEFAULT_PATHS
+    registrations: dict = {}
+    violations = []
+    for f in iter_py_files(root, paths):
+        violations.extend(
+            check_file(f, f.read_text(encoding="utf-8"), registrations))
+    violations.extend(conflicting_types(registrations))
+    for path, lineno, rule, msg in violations:
+        print(f"{path}:{lineno}: [{rule}] {msg}")
+    if violations:
+        print(f"{len(violations)} metric-name violation(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -40,6 +40,7 @@ from pathlib import Path
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.cli.obs import DriverObservability, add_observability_args
 from photon_ml_tpu.data.avro_reader import read_game_dataset
 from photon_ml_tpu.evaluation import build_evaluator
 from photon_ml_tpu.io import schemas
@@ -108,6 +109,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a Chrome trace-event JSON of the run's "
                         "pipeline spans here (load in Perfetto — "
                         "docs/OBSERVABILITY.md)")
+    add_observability_args(p)
     return p
 
 
@@ -170,18 +172,27 @@ def run(argv=None) -> dict:
     # (plus --trace-out for Perfetto) — docs/OBSERVABILITY.md.
     telemetry.reset()
     telemetry.enable(trace=bool(args.trace_out))
-
+    # Live observability plane (docs/OBSERVABILITY.md §Live endpoints):
+    # flight recorder armed for the whole run, HTTP endpoints when
+    # --obs-port is given (a --serve process becomes scrapeable).
+    # Construction/start INSIDE the try: a bad --slo spec or an occupied
+    # --obs-port must still unwind through the finally below (obs.stop()
+    # reverses whatever start() got through — recorder install, SIGTERM
+    # handler — before the failure).
+    obs = None
     try:
+        obs = DriverObservability(args, out_dir).start()
         # Root span: module imports, logging, and glue between the named
         # phases land in `driver` SELF time — the stage table sums to
         # the whole run (attributed_wall_frac >= 0.9 even on millisecond
         # runs) instead of leaving silent gaps.
         with span("driver"):
-            summary = _run_scoring(args, out_dir, logger)
+            summary = _run_scoring(args, out_dir, logger, obs)
 
         wall = time.perf_counter() - t0
         summary["total_seconds"] = wall
         _apply_legacy_aliases(summary)
+        obs.finish(summary)
         summary["telemetry"] = telemetry.attribution_summary(wall)
         if args.trace_out:
             telemetry.export_chrome_trace(args.trace_out)
@@ -191,10 +202,18 @@ def run(argv=None) -> dict:
             json.dumps(summary, indent=2))
         logger.info("scoring done: %s", summary["metrics"])
         return summary
+    except BaseException as e:
+        # Unhandled fault: the spans above have already unwound, so the
+        # flight ring's last events cover the failing stage.
+        if obs is not None:
+            obs.dump_fault(e, logger)
+        raise
     finally:
         # Exception (incl. the --stream SystemExit paths) or not: don't
-        # leave a process-wide recorder armed for whatever runs next in
-        # this process.
+        # leave a process-wide recorder or server armed for whatever
+        # runs next in this process.
+        if obs is not None:
+            obs.stop()
         telemetry.disable()
 
 
@@ -217,7 +236,7 @@ def _apply_legacy_aliases(summary: dict) -> dict:
     return summary
 
 
-def _run_scoring(args, out_dir, logger) -> dict:
+def _run_scoring(args, out_dir, logger, obs) -> dict:
     from photon_ml_tpu.data.paldb import load_feature_index_maps
 
     model_dir = Path(args.game_model_input_dir)
@@ -255,7 +274,7 @@ def _run_scoring(args, out_dir, logger) -> dict:
                          "--serve the concurrent-request replay harness")
     if args.serve:
         summary = _run_serve(args, inputs, id_types, shard_maps, model,
-                             evaluators, scores_path, logger)
+                             evaluators, scores_path, logger, obs)
     elif args.stream:
         summary = _run_stream(args, inputs, id_types, shard_maps, model,
                               evaluators, scores_path, logger)
@@ -363,7 +382,7 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
 
 
 def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
-               scores_path, logger) -> dict:
+               scores_path, logger, obs) -> dict:
     """Concurrent-request replay through the async serving front-end:
     the decoded input splits into ``--request-rows``-row requests,
     ``--serve-concurrency`` closed-loop requesters submit them on an
@@ -392,6 +411,10 @@ def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
     except UnsupportedSubModelError as e:
         raise SystemExit(
             f"--serve requires a device-scorable model: {e}") from e
+    # /statusz carries the front-end's live stats() — per-model serving
+    # stats, admission counters, and the shared executable cache's
+    # tracing-guard counts (docs/OBSERVABILITY.md §Live endpoints).
+    obs.add_status_provider("frontend", frontend.stats)
 
     with span("ingest"):
         requests = []

@@ -21,6 +21,7 @@ import time
 from pathlib import Path
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.cli.obs import DriverObservability, add_observability_args
 from photon_ml_tpu.data.avro_reader import read_game_dataset
 from photon_ml_tpu.data.random_effect import RandomEffectDataConfiguration
 from photon_ml_tpu.estimators.game_estimator import (
@@ -200,6 +201,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "registered by name (utils/events.py) — the "
                         "reference's listener registration, e.g. "
                         "my.module.MyListener")
+    add_observability_args(p)
     return p
 
 
@@ -218,9 +220,17 @@ def run(argv=None) -> dict:
     # instrumented but silent outside a driver (docs/OBSERVABILITY.md).
     telemetry.reset()
     telemetry.enable(trace=bool(args.trace_out))
-
+    # Live observability plane (docs/OBSERVABILITY.md §Live endpoints):
+    # flight recorder armed for the whole run; with --obs-port a
+    # multi-hour --stream-train becomes scrapeable, with a 1 Hz
+    # heartbeat refreshing liveness gauges / registry deltas / SLO
+    # burn between solver iterations. Construction/start INSIDE the
+    # try: a bad --slo spec or occupied --obs-port must still unwind
+    # through the finally below.
+    obs = None
     emitter = EventEmitter()
     try:
+        obs = DriverObservability(args, out_dir, heartbeat_s=1.0).start()
         for cp in (args.event_listeners or "").split(","):
             if cp.strip():
                 emitter.register_listener_by_name(cp.strip())
@@ -237,14 +247,23 @@ def run(argv=None) -> dict:
                           best_configs, best_result, shard_maps)
         summary = _write_summary(args, out_dir, logger, task, sequence,
                                  t0, results, best_configs, best_result,
-                                 num_rows, stream_info)
+                                 num_rows, stream_info, obs)
         emitter.send_event(
             TrainingFinishEvent(args.job_name, summary["totalSeconds"]))
         return summary
+    except BaseException as e:
+        # Unhandled fault: the phase spans have already unwound, so the
+        # flight ring's last events cover the failing stage.
+        if obs is not None:
+            obs.dump_fault(e, logger)
+        raise
     finally:
         # Exception or not: close listeners and disarm the process-wide
-        # recorder so whatever runs next in this process starts clean.
+        # recorder/server so whatever runs next in this process starts
+        # clean.
         emitter.clear_listeners()
+        if obs is not None:
+            obs.stop()
         telemetry.disable()
 
 
@@ -465,7 +484,7 @@ def _save_outputs(args, out_dir, logger, sequence, results,
 
 def _write_summary(args, out_dir, logger, task, sequence, t0, results,
                    best_configs, best_result, num_rows,
-                   stream_info) -> dict:
+                   stream_info, obs) -> dict:
     """metrics.json + trace export — runs AFTER the root ``driver`` span
     closed, so the telemetry block it snapshots includes the root's
     self time (the otherwise-unattributed driver glue)."""
@@ -488,6 +507,7 @@ def _write_summary(args, out_dir, logger, task, sequence, t0, results,
         # deprecated camelCase ``streamTrain`` alias rode one release
         # behind and is now removed (docs/OBSERVABILITY.md §Schema).
         summary["stream_train"] = stream_info
+    obs.finish(summary)
     summary["telemetry"] = telemetry.attribution_summary(wall)
     if args.trace_out:
         telemetry.export_chrome_trace(args.trace_out)

@@ -1,0 +1,178 @@
+"""Driver-side wiring of the live observability plane (shared by the
+GAME training and scoring drivers — one implementation of the
+``--obs-port`` / ``--flight-events`` / ``--slo`` contract).
+
+The telemetry plane itself lives in ``photon_ml_tpu/telemetry/``
+(exposition/recorder/slo modules); libraries never start a server or
+install a recorder — those are process-lifecycle decisions, and the CLI
+drivers own the process. This module is that ownership, factored out so
+both drivers behave identically:
+
+- ``--obs-port P`` starts an :class:`ObservabilityServer` on
+  ``127.0.0.1:P`` (0 = ephemeral) for the duration of the run, serving
+  ``/metrics`` (Prometheus text), ``/healthz``, ``/statusz`` and
+  ``/debugz/dump``. The bound port is written to ``<output-dir>/obs_port``
+  as soon as the server is up (so a harness launching the driver can
+  scrape a live run without parsing logs) and reported in metrics.json
+  under ``observability.port``.
+- ``--flight-events N`` (default 4096; 0 disables) installs a
+  :class:`FlightRecorder`: the last N completed spans + periodic registry
+  deltas, dumped to ``<output-dir>/flight.json`` on an unhandled driver
+  fault, on SIGTERM, and on demand via ``/debugz/dump``. The recorder is
+  ON by default — it exists precisely for the fault nobody armed
+  ``--trace-out`` for, and its per-span cost is one short-lock append on
+  stage-granularity events.
+- ``--slo SPEC`` (repeatable) declares objectives over existing registry
+  metrics (telemetry/slo.py syntax); the tracker's burn-rate counters
+  ride in ``/metrics``, its evaluation in ``/statusz`` and the
+  metrics.json ``slo`` block.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Callable, Dict, Optional
+
+from photon_ml_tpu.telemetry import (
+    FlightRecorder,
+    ObservabilityServer,
+    SLOTracker,
+    install_sigterm_dump,
+)
+
+
+def add_observability_args(p) -> None:
+    """Attach the shared observability flags to a driver parser."""
+    p.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                   help="serve the live observability plane on "
+                        "127.0.0.1:PORT for the duration of the run: "
+                        "/metrics (Prometheus text), /healthz, /statusz "
+                        "(registry + stage attribution + per-model "
+                        "serving stats + SLO), /debugz/dump (flight "
+                        "recorder). 0 binds an ephemeral port, written "
+                        "to <output-dir>/obs_port and reported in "
+                        "metrics.json (docs/OBSERVABILITY.md)")
+    p.add_argument("--flight-events", type=int, default=4096, metavar="N",
+                   help="flight-recorder ring size: the last N completed "
+                        "spans + periodic registry deltas, dumped to "
+                        "<output-dir>/flight.json on an unhandled driver "
+                        "fault, on SIGTERM, and via /debugz/dump "
+                        "(Perfetto-loadable). 0 disables the recorder")
+    p.add_argument("--slo", action="append", default=None, metavar="SPEC",
+                   help="declare a latency/availability objective over "
+                        "existing metrics, e.g. "
+                        "'p99:serving.frontend.request_latency_seconds"
+                        "<=50ms' or 'shed=ratio:serving.frontend.rejected"
+                        "/serving.frontend.admitted+serving.frontend."
+                        "rejected<=0.02'; repeatable. Burn rates surface "
+                        "in /metrics, /statusz and metrics.json slo")
+
+
+class DriverObservability:
+    """One driver run's observability plane: recorder + SLO tracker +
+    HTTP server, built from the parsed args. Lifecycle::
+
+        obs = DriverObservability(args, out_dir).start()
+        try:
+            ...  # the run; obs.add_status_provider() as components come up
+            obs.finish(summary)      # slo/observability metrics.json blocks
+        except BaseException as e:
+            obs.dump_fault(e)        # flight.json evidence, then re-raise
+            raise
+        finally:
+            obs.stop()
+
+    ``heartbeat_s`` (the training driver passes 1.0) keeps liveness
+    gauges, registry deltas and SLO evaluation ticking between scrapes
+    during long solves; the scoring/serving driver leaves it None — its
+    scrape traffic drives freshness.
+    """
+
+    def __init__(self, args, out_dir: Path,
+                 heartbeat_s: Optional[float] = None):
+        self.out_dir = Path(out_dir)
+        self.flight_path = self.out_dir / "flight.json"
+        self.recorder: Optional[FlightRecorder] = (
+            FlightRecorder(max_events=args.flight_events)
+            if args.flight_events > 0 else None)
+        self.slo_tracker: Optional[SLOTracker] = (
+            SLOTracker(args.slo) if args.slo else None)
+        self.server: Optional[ObservabilityServer] = None
+        if args.obs_port is not None:
+            self.server = ObservabilityServer(
+                port=args.obs_port, recorder=self.recorder,
+                slo_tracker=self.slo_tracker, heartbeat_s=heartbeat_s,
+                dump_path=self.flight_path)
+        self._restore_sigterm: Optional[Callable[[], None]] = None
+        self._fault_dumped = False
+
+    def start(self) -> "DriverObservability":
+        if self.recorder is not None:
+            self.recorder.install()
+            self._restore_sigterm = install_sigterm_dump(
+                self.recorder, self.flight_path)
+        if self.server is not None:
+            self.server.start()
+            # Announce the bound port on disk the moment it exists: a
+            # harness that launched this driver can scrape the LIVE run
+            # (obs_port appears before model load / compiles) instead of
+            # discovering the port post-mortem in metrics.json.
+            (self.out_dir / "obs_port").write_text(f"{self.server.port}\n")
+        return self
+
+    def add_status_provider(self, name: str,
+                            fn: Callable[[], dict]) -> None:
+        """Expose a component's stats() under /statusz (no-op without a
+        server — the provider contract is read-only either way)."""
+        if self.server is not None:
+            self.server.add_status_provider(name, fn)
+
+    def dump_fault(self, exc: BaseException, logger=None) -> None:
+        """Unhandled-fault hook: leave flight.json evidence. SystemExit
+        is an intentional CLI exit (argument validation, documented
+        degradations) — no evidence needed; everything else (including
+        KeyboardInterrupt on a wedged run) dumps. The span context
+        managers have already unwound through the failing stage by the
+        time the driver's except block runs, so the ring's last events
+        cover it."""
+        if (self.recorder is None or self._fault_dumped
+                or isinstance(exc, SystemExit)):
+            return
+        try:
+            self.recorder.dump(self.flight_path,
+                               reason=f"fault:{type(exc).__name__}")
+            self._fault_dumped = True
+            if logger is not None:
+                logger.error("flight recorder dumped to %s (%s)",
+                             self.flight_path, type(exc).__name__)
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            pass
+
+    def finish(self, summary: Dict) -> Dict:
+        """Attach the ``slo`` and ``observability`` metrics.json blocks
+        (call before the summary is written, while the server counters
+        are final-ish)."""
+        if self.slo_tracker is not None:
+            summary["slo"] = self.slo_tracker.evaluate()
+        if self.server is not None or self.recorder is not None:
+            summary["observability"] = {
+                "server": (self.server.summary()
+                           if self.server is not None else None),
+                "flight_recorder": (self.recorder.stats()
+                                    if self.recorder is not None else None),
+                "flight_path": (str(self.flight_path)
+                                if self.recorder is not None
+                                and self.recorder.dumps > 0 else None),
+            }
+        return summary
+
+    def stop(self) -> None:
+        """Idempotent teardown: restore SIGTERM, stop the server,
+        detach the recorder from the process tracer."""
+        if self._restore_sigterm is not None:
+            self._restore_sigterm()
+            self._restore_sigterm = None
+        if self.server is not None:
+            self.server.stop()
+        if self.recorder is not None:
+            self.recorder.uninstall()

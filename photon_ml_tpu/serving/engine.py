@@ -461,6 +461,47 @@ class StreamingGameScorer:
                 self._m_dispatches.inc()
             return fn(*args, self._params)
 
+    #: _stats keys rolled back by :meth:`rollback_stats` — request/row
+    #: SERVICE accounting plus its padding-waste companions. Deliberately
+    #: excludes ``dispatches``: a discarded partial dispatch still ran on
+    #: the device, so the dispatch count stays an honest work counter.
+    _ROLLBACK_KEYS = ("requests", "rows_scored", "rows_padded",
+                      "nnz_scored", "nnz_padded")
+
+    def stats_checkpoint(self) -> Dict[str, int]:
+        """Snapshot of the request-accounting stats, for
+        :meth:`rollback_stats` after a failed ``score_many`` attempt."""
+        return {k: self._stats[k] for k in self._ROLLBACK_KEYS}
+
+    def rollback_stats(self, checkpoint: Dict[str, int]) -> None:
+        """Un-count a FAILED ``score_many`` attempt: subtract everything
+        accounted since ``checkpoint`` from the per-engine stats and the
+        registry twins (global + per-model), so requests/rows_scored
+        count each SERVED request exactly once even when the front-end's
+        fault-isolation path re-scores a window solo (the PR 8 docstring
+        caveat, now fixed — tests/test_serving_frontend.py).
+
+        Caller contract: single mutator (the front-end's one dispatch
+        thread), checkpoint taken immediately before the attempt. The
+        registry decrement briefly violates Prometheus counter
+        monotonicity on this rare error path; exact accounting (the
+        ``admitted == completed + failed + cancelled`` conservation
+        law) wins over strict monotonicity here. Latency histograms are NOT rolled back
+        — a settled sub-group really did wait that long; its retry is a
+        second real observation."""
+        d_req = self._stats["requests"] - checkpoint["requests"]
+        d_rows = self._stats["rows_scored"] - checkpoint["rows_scored"]
+        for k in self._ROLLBACK_KEYS:
+            self._stats[k] = checkpoint[k]
+        if d_req:
+            _M_REQUESTS.inc(-d_req)
+            if self._m_requests is not None:
+                self._m_requests.inc(-d_req)
+        if d_rows:
+            _M_ROWS_SCORED.inc(-d_rows)
+            if self._m_rows_scored is not None:
+                self._m_rows_scored.inc(-d_rows)
+
     def _observe_latency(self, seconds: float, n: int = 1) -> None:
         """``n`` requests settled at one latency (a coalesced group
         shares its dispatch wall time): feed the process-wide latency

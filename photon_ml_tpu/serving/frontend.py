@@ -62,6 +62,14 @@ from photon_ml_tpu.utils.tracing_guard import TracingGuard
 _M_ADMITTED = telemetry.counter("serving.frontend.admitted")
 _M_REJECTED = telemetry.counter("serving.frontend.rejected")
 _M_COMPLETED = telemetry.counter("serving.frontend.completed")
+# Admitted requests that settled with an error (fault isolation routed
+# the offender's exception to its own caller) / whose caller cancelled
+# the future before its group settled (e.g. asyncio.wait_for timeout).
+# Conservation law: admitted == completed + failed + cancelled once the
+# front-end drains, and every request that entered score() is exactly
+# one of {admitted, rejected} (docs/OBSERVABILITY.md).
+_M_FAILED = telemetry.counter("serving.frontend.failed")
+_M_CANCELLED = telemetry.counter("serving.frontend.cancelled")
 _M_GROUPS = telemetry.counter("serving.frontend.coalesced_groups")
 _M_SWAPS = telemetry.counter("serving.frontend.model_swaps")
 _H_QUEUE_WAIT = telemetry.histogram("serving.frontend.queue_wait_seconds")
@@ -93,13 +101,18 @@ class RequestRejected(FrontendError):
     typed rejection is the overload CONTRACT — callers retry elsewhere /
     later instead of queueing into a latency cliff."""
 
-    def __init__(self, model: str, pending: int, limit: int):
+    def __init__(self, model: str, pending: int, limit: int,
+                 scope: str = "process"):
+        what = ("max_pending" if scope == "process"
+                else "max_pending_per_model")
         super().__init__(
             f"request for model {model!r} rejected: {pending} requests "
-            f"already pending >= max_pending={limit} (overload load-shed)")
+            f"already pending >= {what}={limit} (overload load-shed, "
+            f"{scope} scope)")
         self.model = model
         self.pending = pending
         self.limit = limit
+        self.scope = scope
 
 
 @dataclasses.dataclass(frozen=True)
@@ -113,6 +126,14 @@ class FrontendConfig:
       the executor busy).
     - ``max_pending``: admission bound on admitted-and-unfinished
       requests; beyond it ``score`` raises :class:`RequestRejected`.
+    - ``max_pending_per_model``: optional PER-MODEL admission quota —
+      with N tenants sharing the process bound, one hot model could
+      otherwise fill ``max_pending`` and starve a quiet tenant whose
+      own traffic is tiny. Requests for a model at its quota shed with
+      a typed :class:`RequestRejected` (``scope="model"``) while other
+      models keep admitting; per-model sheds surface as
+      ``serving.model.<label>.rejected`` (and in ``stats()`` /
+      ``/statusz``). None (default) = no per-model bound.
     - ``max_group_rows``: dispatch a group early once this many rows are
       queued (default: the ladder's ``max_rows`` — a full top bucket;
       waiting longer could not pack any denser).
@@ -120,6 +141,7 @@ class FrontendConfig:
 
     coalesce_window_s: float = 0.002
     max_pending: int = 1024
+    max_pending_per_model: Optional[int] = None
     max_group_rows: Optional[int] = None
 
 
@@ -169,10 +191,15 @@ class ServingFrontend:
         self._pipeline_depth = pipeline_depth
         self._engines: Dict[str, StreamingGameScorer] = {}
         self._stats = {"admitted": 0, "rejected": 0, "completed": 0,
-                       "failed": 0, "coalesced_groups": 0,
+                       "failed": 0, "cancelled": 0, "coalesced_groups": 0,
                        "dispatch_groups": 0, "model_swaps": 0,
                        "isolation_splits": 0}
         self._pending = 0
+        # Per-model admission view (always tracked — cheap dict ops on
+        # the event loop; the quota only REJECTS when configured).
+        self._pending_by_model: Dict[str, int] = {}
+        self._rejected_by_model: Dict[str, int] = {}
+        self._m_rejected_by_model: Dict[str, object] = {}
         self._queue: deque = deque()
         self._queued_rows = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -289,13 +316,21 @@ class ServingFrontend:
         if engine is None:
             raise UnknownModelError(model, self._engines)
         if self._pending >= self.config.max_pending:
-            self._stats["rejected"] += 1
-            _M_REJECTED.inc()
+            self._reject(model)
             raise RequestRejected(model, self._pending,
                                   self.config.max_pending)
+        quota = self.config.max_pending_per_model
+        model_pending = self._pending_by_model.get(model, 0)
+        if quota is not None and model_pending >= quota:
+            # Per-model shed: THIS tenant is at its quota; the process
+            # still has headroom, so other models keep admitting.
+            self._reject(model)
+            raise RequestRejected(model, model_pending, quota,
+                                  scope="model")
         fut = self._loop.create_future()
         p = _Pending(data, model, engine, fut, time.perf_counter())
         self._pending += 1
+        self._pending_by_model[model] = model_pending + 1
         # The registry twin of this counter is batch-incremented at
         # group formation (one lock per group); the stats dict is the
         # always-live per-admission view.
@@ -307,6 +342,21 @@ class ServingFrontend:
             return await fut
         finally:
             self._pending -= 1
+            self._pending_by_model[model] -= 1
+
+    def _reject(self, model: str) -> None:
+        """Shed accounting: process-wide counters plus the per-model
+        ``serving.model.<label>.rejected`` twin (lazily created per
+        resident model name; surfaced in ``stats()`` and /statusz)."""
+        self._stats["rejected"] += 1
+        self._rejected_by_model[model] = \
+            self._rejected_by_model.get(model, 0) + 1
+        _M_REJECTED.inc()
+        m = self._m_rejected_by_model.get(model)
+        if m is None:
+            m = self._m_rejected_by_model[model] = telemetry.counter(
+                f"serving.model.{model}.rejected")
+        m.inc()
 
     # -- coalescing batcher ------------------------------------------------
 
@@ -385,24 +435,33 @@ class ServingFrontend:
         failing group retries per-request and only the offender errors
         (fault isolation; counted in ``isolation_splits``).
 
-        Known trade-off: if the window spanned SEVERAL engine dispatch
-        groups and a later group failed, the retry re-scores requests
-        whose group already dispatched — their results stay correct,
-        but the engine's requests/rows_scored counters over-count them
-        on this (rare, error-path-only) branch. ``score_many`` discards
-        partials on failure, so avoiding it would mean re-implementing
-        the engine's packing here; not worth it for an error path."""
+        Accounting on the retry path is EXACT: a failed ``score_many``
+        attempt may have counted requests whose internal dispatch group
+        completed before the failure (``score_many`` discards the
+        partial results), so the attempt's request/row accounting is
+        rolled back (``engine.rollback_stats``) before the solo retries
+        re-count each request — once per request that actually gets a
+        result, zero for the offender. The engine's requests and
+        rows_scored therefore equal the requests it successfully served
+        even on this path (this was PR 8's documented over-count
+        caveat; regression-tested in tests/test_serving_frontend.py).
+        Latency histograms are deliberately not rolled back — see
+        ``rollback_stats``."""
+        ckpt = engine.stats_checkpoint()
         try:
             return [(r, None) for r in engine.score_many(datasets)]
         except Exception:  # noqa: BLE001 — isolate, then re-raise solo
+            engine.rollback_stats(ckpt)
             if len(datasets) == 1:
                 raise
         self._stats["isolation_splits"] += 1
         out = []
         for ds in datasets:
+            ckpt = engine.stats_checkpoint()
             try:
                 out.append((engine.score_many([ds])[0], None))
             except Exception as e:  # noqa: BLE001 — per-request verdict
+                engine.rollback_stats(ckpt)
                 out.append((None, e))
         return out
 
@@ -417,8 +476,12 @@ class ServingFrontend:
         with span("scatter"):
             now = time.perf_counter()
             lats: List[float] = []
+            n_failed = 0
+            n_cancelled = 0
             for p, (res, err) in zip(items, results):
                 if p.future.done():  # caller cancelled; nothing to route
+                    self._stats["cancelled"] += 1
+                    n_cancelled += 1
                     continue
                 if err is None:
                     p.future.set_result(res)
@@ -427,6 +490,11 @@ class ServingFrontend:
                 else:
                     p.future.set_exception(err)
                     self._stats["failed"] += 1
+                    n_failed += 1
+            if n_failed:
+                _M_FAILED.inc(n_failed)
+            if n_cancelled:
+                _M_CANCELLED.inc(n_cancelled)
             if lats:  # one locked batch per settled group
                 _M_COMPLETED.inc(len(lats))
                 _H_LATENCY.observe_many(lats)
@@ -525,6 +593,11 @@ class ServingFrontend:
             **dict(self._stats),
             "pending": self._pending,
             "max_pending": self.config.max_pending,
+            "max_pending_per_model": self.config.max_pending_per_model,
+            "pending_by_model": dict(sorted(
+                self._pending_by_model.items())),
+            "rejected_by_model": dict(sorted(
+                self._rejected_by_model.items())),
             "coalesce_window_s": self.coalesce_window_s,
             "max_group_rows": self.max_group_rows,
             "queue_wait_seconds": _H_QUEUE_WAIT.snapshot(),

@@ -43,6 +43,21 @@ from photon_ml_tpu.telemetry.spans import (
     timed_span,
     tracer,
 )
+from photon_ml_tpu.telemetry.exposition import (
+    ObservabilityServer,
+    prometheus_name,
+    render_prometheus,
+)
+from photon_ml_tpu.telemetry.recorder import (
+    FlightRecorder,
+    install_sigterm_dump,
+)
+from photon_ml_tpu.telemetry.slo import (
+    LatencyObjective,
+    RatioObjective,
+    SLOTracker,
+    parse_slo,
+)
 
 
 def enable(trace: bool = False) -> None:
@@ -81,9 +96,14 @@ def snapshot() -> dict:
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "LatencyObjective",
     "MetricsRegistry",
+    "ObservabilityServer",
+    "RatioObjective",
+    "SLOTracker",
     "Tracer",
     "attribution_summary",
     "counter",
@@ -93,7 +113,11 @@ __all__ = [
     "export_chrome_trace",
     "gauge",
     "histogram",
+    "install_sigterm_dump",
+    "parse_slo",
+    "prometheus_name",
     "registry",
+    "render_prometheus",
     "reset",
     "snapshot",
     "span",

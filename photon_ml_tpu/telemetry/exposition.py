@@ -1,0 +1,375 @@
+"""Live metrics exposition: Prometheus text rendering of the registry
+and a stdlib-only HTTP observability server.
+
+PR 6's telemetry was *post-mortem only* — rich counters, spans and
+histograms that nobody could see until the driver wrote metrics.json. A
+live ``--serve`` process under heavy traffic (or a multi-hour
+``--stream-train``) needs the continuous-monitoring plane the Spark-era
+reference got for free from the Spark UI: something a scraper can poll,
+a load balancer can health-check, and an operator can hit at fault time.
+
+Two pieces, both dependency-free:
+
+- :func:`render_prometheus` maps the :class:`MetricsRegistry` snapshot
+  onto Prometheus text format 0.0.4. The mapping is faithful by
+  construction: registry histograms already use upper-edge-inclusive
+  buckets (``le`` semantics), so exposition is a running sum — never a
+  re-bin — with the implicit overflow bucket rendered as ``+Inf``.
+  Dotted snake_case registry names (``serving.frontend.admitted``)
+  become legal Prometheus names by replacing every character outside
+  ``[a-zA-Z0-9_:]`` with ``_``; counters gain the conventional
+  ``_total`` suffix. The original dotted name rides in the ``# HELP``
+  line, so dashboards can be built against either spelling.
+- :class:`ObservabilityServer` serves ``/metrics`` (Prometheus text),
+  ``/healthz`` (liveness JSON), ``/statusz`` (full JSON status:
+  registry snapshot, stage attribution, registered status providers —
+  the serving front-end plugs its ``stats()`` in here, which carries
+  per-model serving stats and the executable cache's tracing-guard
+  counts — and the SLO block) and ``/debugz/dump`` (flight-recorder
+  dump, telemetry/recorder.py) from a background daemon thread on
+  ``http.server``. Request handling only READS telemetry state (every
+  structure is lock-guarded or copied), so a scrape can never corrupt a
+  hot path; its cost is measured in the bench ``observability`` extra.
+
+The server is wired in by the CLI drivers (``--obs-port``; 0 binds an
+ephemeral port, reported in metrics.json) — libraries never start one,
+the same discipline as the telemetry enable flag.
+"""
+
+from __future__ import annotations
+
+import http.server
+import importlib
+import json
+import re
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+# Submodules via importlib: the package re-exports ``registry`` (the
+# accessor FUNCTION) under the same name as this module, so a plain
+# ``from photon_ml_tpu.telemetry import registry`` would bind the
+# function — same discipline as spans.py.
+_reg = importlib.import_module("photon_ml_tpu.telemetry.registry")
+_spans = importlib.import_module("photon_ml_tpu.telemetry.spans")
+
+_INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str) -> str:
+    """Registry dotted snake_case -> legal Prometheus metric name:
+    every character outside ``[a-zA-Z0-9_:]`` becomes ``_`` (dots
+    included — ``serving.frontend.admitted`` ->
+    ``serving_frontend_admitted``) and a leading digit gains a ``_``
+    prefix. Label-free by design: the registry encodes dimensions in
+    the dotted namespace (``serving.model.<label>.requests``), so the
+    whole name sanitizes as one unit."""
+    out = _INVALID_CHARS.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt_value(v) -> str:
+    """One sample value in Prometheus text syntax (Go-parseable float;
+    integral values render bare so counters stay exact)."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 2 ** 53:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_prometheus(registry: Optional[_reg.MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus text exposition format 0.0.4.
+
+    Per metric family: ``# HELP`` (carrying the original dotted registry
+    name), ``# TYPE``, then samples. Histograms emit cumulative
+    ``_bucket{le="..."}`` series (one per configured bound, plus
+    ``le="+Inf"`` == observation count), ``_sum`` and ``_count`` — each
+    histogram's series come from ONE locked read
+    (:meth:`Histogram.exposition_state`), so they are mutually
+    consistent even under concurrent observation. In the (schema-
+    violating) event two dotted names sanitize to one Prometheus name,
+    the first wins and the collision is reported as a comment rather
+    than emitting an invalid duplicate family."""
+    reg = registry if registry is not None else _reg.registry()
+    counters, gauges, histograms = reg.metrics()
+    out = []
+    seen: Dict[str, str] = {}
+
+    def claim(pname: str, dotted: str) -> bool:
+        prev = seen.get(pname)
+        if prev is not None and prev != dotted:
+            out.append(f"# collision: {dotted!r} also sanitizes to "
+                       f"{pname!r} (kept {prev!r})")
+            return False
+        seen[pname] = dotted
+        return True
+
+    for name in sorted(counters):
+        pname = prometheus_name(name) + "_total"
+        if not claim(pname, name):
+            continue
+        out.append(f"# HELP {pname} "
+                   f"{_escape_help('registry counter ' + name)}")
+        out.append(f"# TYPE {pname} counter")
+        out.append(f"{pname} {_fmt_value(counters[name].value)}")
+    for name in sorted(gauges):
+        pname = prometheus_name(name)
+        if not claim(pname, name):
+            continue
+        out.append(f"# HELP {pname} "
+                   f"{_escape_help('registry gauge ' + name)}")
+        out.append(f"# TYPE {pname} gauge")
+        out.append(f"{pname} {_fmt_value(gauges[name].value)}")
+    for name in sorted(histograms):
+        pname = prometheus_name(name)
+        if not claim(pname, name):
+            continue
+        bounds, cum, count, total = histograms[name].exposition_state()
+        out.append(f"# HELP {pname} "
+                   f"{_escape_help('registry histogram ' + name)}")
+        out.append(f"# TYPE {pname} histogram")
+        for b, c in zip(bounds, cum):
+            out.append(f'{pname}_bucket{{le="{_fmt_value(b)}"}} {c}')
+        out.append(f'{pname}_bucket{{le="+Inf"}} {count}')
+        out.append(f"{pname}_sum {_fmt_value(total)}")
+        out.append(f"{pname}_count {count}")
+    return "\n".join(out) + "\n"
+
+
+def _json_default(o):
+    """metrics/stats blocks can carry numpy scalars and tuples of
+    non-JSON types; render numbers as numbers and everything else as
+    its string form rather than failing a live scrape."""
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return str(o)
+
+
+class _ObsHTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    obs: "ObservabilityServer" = None
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "photon-obs/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:
+        pass  # stay silent: the obs plane must not spam driver stderr
+
+    def _send(self, status: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        obs = self.server.obs
+        path = self.path.split("?", 1)[0]
+        try:
+            route = obs._routes.get(path)
+            if route is None:
+                self._send(404, json.dumps(
+                    {"error": f"no route {path!r}",
+                     "routes": sorted(obs._routes)}) + "\n",
+                    "application/json")
+                return
+            body, ctype = route()
+            self._send(200, body, ctype)
+        except BrokenPipeError:
+            pass  # scraper went away mid-response
+        except Exception as e:  # noqa: BLE001 — a scrape must not crash
+            try:
+                self._send(500, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}) + "\n",
+                    "application/json")
+            except Exception:  # noqa: BLE001 — socket already gone
+                pass
+
+
+class ObservabilityServer:
+    """Background-thread HTTP server exposing the live telemetry plane.
+
+    Routes: ``/metrics`` (Prometheus text), ``/healthz``, ``/statusz``,
+    ``/debugz/dump``. ``port=0`` binds an ephemeral port; read ``.port``
+    after :meth:`start`. Optional collaborators:
+
+    - ``recorder``: a :class:`FlightRecorder` — enables ``/debugz/dump``
+      (dump returned as the response body and, when ``dump_path`` is
+      set, also written there).
+    - ``slo_tracker``: an :class:`SLOTracker` — its evaluation rides in
+      ``/statusz`` under ``slo`` (and advances burn counters).
+    - ``status_providers``: ``{name: zero-arg callable -> dict}`` merged
+      into ``/statusz`` under ``status`` (the serving front-end
+      registers its ``stats()`` here; a provider that raises reports
+      its error inline instead of failing the whole page).
+    - ``heartbeat_s``: period of a liveness ticker that refreshes the
+      ``process.uptime_seconds`` / ``process.heartbeat_unix_time``
+      gauges, lets the flight recorder capture periodic registry deltas
+      even while no spans are closing, and re-evaluates the SLO tracker
+      — the opt-in training-driver heartbeat.
+
+    Usable as a context manager; :meth:`stop` is idempotent.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 recorder=None, slo_tracker=None,
+                 status_providers: Optional[
+                     Dict[str, Callable[[], dict]]] = None,
+                 heartbeat_s: Optional[float] = None,
+                 dump_path=None):
+        self._host = host
+        self._requested_port = int(port)
+        self.recorder = recorder
+        self.slo_tracker = slo_tracker
+        self.heartbeat_s = heartbeat_s
+        self.dump_path = dump_path
+        self.scrapes = 0  # plain int: live even with telemetry disabled
+        self._m_scrapes = _reg.registry().counter("observability.scrapes")
+        self._providers: Dict[str, Callable[[], dict]] = dict(
+            status_providers or {})
+        self._httpd: Optional[_ObsHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._t0 = time.monotonic()
+        self._routes = {
+            "/metrics": self._metrics,
+            "/healthz": self._healthz,
+            "/statusz": self._statusz,
+            "/debugz/dump": self._debugz_dump,
+        }
+
+    # -- routes ------------------------------------------------------------
+
+    def _metrics(self):
+        self.scrapes += 1
+        self._m_scrapes.inc()
+        return (render_prometheus(),
+                "text/plain; version=0.0.4; charset=utf-8")
+
+    def _healthz(self):
+        return (json.dumps({
+            "status": "ok",
+            "uptime_seconds": round(time.monotonic() - self._t0, 3),
+        }) + "\n", "application/json")
+
+    def _statusz(self):
+        status = {}
+        for name, fn in sorted(self._providers.items()):
+            try:
+                status[name] = fn()
+            except Exception as e:  # noqa: BLE001 — report, don't 500
+                status[name] = {"error": f"{type(e).__name__}: {e}"}
+        body = {
+            "uptime_seconds": round(time.monotonic() - self._t0, 3),
+            "scrapes": self.scrapes,
+            "telemetry_enabled": _reg.enabled(),
+            "metrics": _reg.registry().snapshot(),
+            "stage_attribution": _spans.stage_attribution(),
+            "status": status,
+            "slo": (self.slo_tracker.evaluate()
+                    if self.slo_tracker is not None else None),
+            "flight_recorder": (self.recorder.stats()
+                                if self.recorder is not None else None),
+        }
+        return (json.dumps(body, indent=2, default=_json_default) + "\n",
+                "application/json")
+
+    def _debugz_dump(self):
+        if self.recorder is None:
+            return (json.dumps({"error": "no flight recorder installed "
+                                         "(driver --flight-events 0?)"})
+                    + "\n", "application/json")
+        dump = self.recorder.dump(path=self.dump_path, reason="debugz")
+        return (json.dumps(dump, default=_json_default) + "\n",
+                "application/json")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def add_status_provider(self, name: str,
+                            fn: Callable[[], dict]) -> None:
+        self._providers[name] = fn
+
+    @property
+    def port(self) -> Optional[int]:
+        """Bound port (survives stop(), so a driver can report it in
+        metrics.json after tearing the server down)."""
+        return self._bound_port
+
+    _bound_port: Optional[int] = None
+
+    def start(self) -> "ObservabilityServer":
+        if self._httpd is not None:
+            raise RuntimeError("observability server already started")
+        self._t0 = time.monotonic()
+        self._httpd = _ObsHTTPServer((self._host, self._requested_port),
+                                     _Handler)
+        self._httpd.obs = self
+        self._bound_port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="obs-server", daemon=True)
+        self._thread.start()
+        if self.heartbeat_s:
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat, name="obs-heartbeat", daemon=True)
+            self._hb_thread.start()
+        return self
+
+    def _heartbeat(self) -> None:
+        uptime = _reg.registry().gauge("process.uptime_seconds")
+        beat = _reg.registry().gauge("process.heartbeat_unix_time")
+        while not self._hb_stop.wait(self.heartbeat_s):
+            uptime.set(time.monotonic() - self._t0)
+            beat.set(time.time())
+            if self.recorder is not None:
+                self.recorder.tick()
+            if self.slo_tracker is not None:
+                self.slo_tracker.evaluate()
+
+    def stop(self) -> None:
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "ObservabilityServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def summary(self) -> dict:
+        """The metrics.json ``observability`` block."""
+        return {
+            "port": self.port,
+            "host": self._host,
+            "scrapes": self.scrapes,
+            "heartbeat_s": self.heartbeat_s,
+            "routes": sorted(self._routes),
+        }

@@ -227,6 +227,25 @@ class Histogram:
         return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
                 "p99": self.quantile(0.99)}
 
+    def exposition_state(self) -> Tuple[Tuple[float, ...], list, int, float]:
+        """Atomic ``(bounds, cumulative_counts, count, sum)`` for
+        Prometheus exposition and SLO math: ``cumulative_counts[i]`` is
+        the number of samples ``<= bounds[i]`` (``le`` semantics — the
+        bucket layout already matches, so the mapping is a running sum,
+        not a re-bin), and the implicit ``+Inf`` bucket equals
+        ``count``. One lock acquisition, so a scrape racing ``observe``
+        sees a consistent histogram (cumulative counts monotone,
+        ``sum``/``count`` from the same instant)."""
+        with self._lock:
+            counts = list(self._counts)
+            count, total = self._count, self._sum
+        cum = []
+        c = 0
+        for v in counts[:-1]:
+            c += v
+            cum.append(c)
+        return self._bounds, cum, count, total
+
     def snapshot(self) -> Dict:
         with self._lock:
             count, total = self._count, self._sum
@@ -285,6 +304,15 @@ class MetricsRegistry:
             if m is None:
                 m = self._histograms[name] = Histogram(name, buckets)
             return m
+
+    def metrics(self) -> Tuple[Dict[str, Counter], Dict[str, Gauge],
+                               Dict[str, Histogram]]:
+        """Shallow copies of the three name->metric maps (the exposition
+        renderer and flight recorder iterate metric OBJECTS, not the
+        plain-value snapshot)."""
+        with self._lock:
+            return (dict(self._counters), dict(self._gauges),
+                    dict(self._histograms))
 
     def snapshot(self) -> Dict:
         with self._lock:

@@ -70,6 +70,12 @@ class Tracer:
         self._lock = threading.Lock()
         self._local = threading.local()
         self.record_events = False
+        # Optional FlightRecorder (telemetry/recorder.py) fed every
+        # completed span. Deliberately NOT cleared by reset(): drivers
+        # reset telemetry at startup and install the recorder after —
+        # the recorder's lifetime is the driver run's, not the
+        # aggregation window's.
+        self.flight = None
         self.reset()
 
     def reset(self) -> None:
@@ -109,6 +115,13 @@ class Tracer:
                         "dur": dur * 1e6})
                 else:
                     self.dropped_events += 1
+        # Flight ring rides OUTSIDE the aggregation lock (it has its
+        # own); one attribute load + None check when no recorder is
+        # installed, nothing at all while telemetry is disabled (span()
+        # never reaches _record then).
+        fl = self.flight
+        if fl is not None:
+            fl.record_span(name, t0, t1, tid)
 
     # -- reporting ---------------------------------------------------------
 
@@ -133,21 +146,32 @@ class Tracer:
         with self._lock:
             events = list(self.events)
             main_tid = self.main_tid
-        tids = sorted({e["tid"] for e in events})
-        tid_ix = {t: i for i, t in enumerate(tids)}
         pid = os.getpid()
-        out = []
-        for t in tids:
-            out.append({"name": "thread_name", "ph": "M", "pid": pid,
-                        "tid": tid_ix[t],
-                        "args": {"name": ("driver" if t == main_tid
-                                          else f"worker-{tid_ix[t]}")}})
+        tid_ix, out = thread_track_metadata(
+            {e["tid"] for e in events}, main_tid, pid)
         for e in events:
             out.append({"name": e["name"], "ph": "X", "cat": "photon",
                         "pid": pid, "tid": tid_ix[e["tid"]],
                         "ts": e["ts"], "dur": e["dur"]})
         with open(path, "w") as f:
             json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+
+
+def thread_track_metadata(tids, main_tid: int, pid: int):
+    """Chrome-trace thread tracks shared by ``export_chrome_trace`` and
+    the flight recorder's dump (telemetry/recorder.py), so the two
+    artifacts always line up in Perfetto: raw thread idents map to
+    dense track indices (``tid_ix``) and the returned event list opens
+    with one ``thread_name`` metadata record per track (the tracer's
+    main thread is ``driver``, others ``worker-<ix>``)."""
+    ordered = sorted(tids)
+    tid_ix = {t: i for i, t in enumerate(ordered)}
+    out = [{"name": "thread_name", "ph": "M", "pid": pid,
+            "tid": tid_ix[t],
+            "args": {"name": ("driver" if t == main_tid
+                              else f"worker-{tid_ix[t]}")}}
+           for t in ordered]
+    return tid_ix, out
 
 
 _TRACER = Tracer()

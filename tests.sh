@@ -10,6 +10,9 @@ echo "== lint =="
 # One phase, one file walk: style checks (dev_scripts/lint.py) + the
 # JAX-aware static analysis gate (dev_scripts/jaxlint.py, docs/ANALYSIS.md).
 python dev_scripts/jaxlint.py --with-style
+# Metric-name schema gate (dotted snake_case, no conflicting-type
+# registrations — docs/OBSERVABILITY.md §Prometheus naming).
+python dev_scripts/metric_names.py
 
 echo "== tests =="
 python -m pytest tests/ -q "$@"

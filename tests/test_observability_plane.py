@@ -1,0 +1,203 @@
+"""Driver-level integration of the live observability plane
+(photon_ml_tpu/cli/obs.py): a --serve run with --obs-port answers
+/metrics (validated by this suite's own Prometheus parser), /healthz and
+/statusz WHILE running; a forced driver fault leaves a Perfetto-loadable
+flight.json whose last events cover the failing stage; the SLO block
+lands in metrics.json. Unit-level semantics live in test_exposition.py."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import game_scoring_driver, game_training_driver
+
+from tests.test_cli_drivers import _train_small_game, _write_sparse_fe_avro
+from tests.test_exposition import parse_prometheus
+
+
+def _scrape_while_alive(out_dir, results):
+    """Background scraper: wait for <out_dir>/obs_port, then poll the
+    three endpoints until the server goes away, keeping the last
+    successful body of each."""
+    port_file = out_dir / "obs_port"
+    deadline = time.monotonic() + 60
+    while not port_file.exists():
+        if time.monotonic() > deadline:
+            results["error"] = "obs_port file never appeared"
+            return
+        time.sleep(0.01)
+    port = int(port_file.read_text().strip())
+    results["port"] = port
+    while True:
+        try:
+            for route, key in (("/metrics", "metrics"),
+                               ("/healthz", "healthz"),
+                               ("/statusz", "statusz")):
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{route}", timeout=5)
+                assert r.status == 200
+                results[key] = r.read().decode()
+            results["scrapes"] = results.get("scrapes", 0) + 1
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return  # server stopped with the driver: done
+        time.sleep(0.02)
+
+
+@pytest.mark.needs_f64
+def test_serve_with_obs_port_answers_live(tmp_path, rng):
+    """Acceptance: a live --serve --obs-port process answers /metrics in
+    valid Prometheus text (our own parser), /healthz, and /statusz —
+    scraped WHILE the driver runs, not post-mortem."""
+    model_dir, valid = _train_small_game(tmp_path, rng)
+    out = tmp_path / "score-serve-obs"
+    out.mkdir()
+    results = {}
+    scraper = threading.Thread(
+        target=_scrape_while_alive, args=(out, results), daemon=True)
+    scraper.start()
+    summary = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(model_dir),
+        "--output-dir", str(out),
+        "--serve", "--request-rows", "7", "--serve-concurrency", "8",
+        "--coalesce-ms", "1", "--feeder", "python",
+        "--obs-port", "0",
+        "--slo", "shed=ratio:serving.frontend.rejected/"
+                 "serving.frontend.admitted+serving.frontend.rejected"
+                 "<=0.05",
+    ])
+    scraper.join(timeout=60)
+    assert "error" not in results
+    assert results.get("scrapes", 0) >= 1, "never scraped the live run"
+    # /metrics parsed under the suite's own strict reader
+    fams = parse_prometheus(results["metrics"])
+    assert "observability_scrapes_total" in fams
+    assert json.loads(results["healthz"])["status"] == "ok"
+    statusz = json.loads(results["statusz"])
+    assert statusz["telemetry_enabled"] is True
+    assert "metrics" in statusz and "stage_attribution" in statusz
+    assert "shed" in statusz["slo"]
+    # the run itself is unchanged by being observed
+    assert summary["scoring_path"] == "async-frontend"
+    # metrics.json carries the observability + slo blocks
+    obs_block = summary["observability"]
+    assert obs_block["server"]["port"] == results["port"]
+    assert obs_block["server"]["scrapes"] >= results["scrapes"]
+    assert obs_block["flight_recorder"]["ring_capacity"] == 4096
+    assert summary["slo"]["shed"]["compliant"] is True
+    # the frontend's stats ride under /statusz once serving started
+    # (best-effort: the scraper may have stopped before _run_serve
+    # registered the provider on very fast runs)
+    if statusz["status"].get("frontend"):
+        fe = statusz["status"]["frontend"]
+        assert "pending_by_model" in fe and "cache" in fe
+
+
+def test_driver_fault_dumps_flight_json(tmp_path, rng):
+    """Acceptance: a forced fault (corrupt Avro input) produces a
+    Perfetto-loadable flight.json whose LAST events cover the failing
+    stage — the spans unwound through the fault before the dump."""
+    model_dir, _ = _train_small_game(tmp_path, rng)
+    bad_in = tmp_path / "bad-input"
+    bad_in.mkdir()
+    (bad_in / "part-00000.avro").write_bytes(b"this is not avro")
+    out = tmp_path / "score-fault"
+    with pytest.raises(Exception) as ei:
+        game_scoring_driver.run([
+            "--input-dirs", str(bad_in),
+            "--game-model-input-dir", str(model_dir),
+            "--output-dir", str(out),
+        ])
+    assert not isinstance(ei.value, SystemExit)
+    flight = json.loads((out / "flight.json").read_text())
+    assert flight["flight"]["reason"] == \
+        f"fault:{type(ei.value).__name__}"
+    # Perfetto shape: trace events with M/X/C phases + the flight block
+    assert {e["ph"] for e in flight["traceEvents"]} <= {"M", "X", "C"}
+    names = [e["name"] for e in flight["traceEvents"]
+             if e.get("ph") == "X"]
+    # the failing stage (ingest reads the corrupt container) and the
+    # root driver span both unwound into the ring; driver is LAST
+    assert "ingest" in names and names[-1] == "driver"
+    assert flight["flight"]["final_metrics"]["counters"] is not None
+    assert "ingest" in flight["flight"]["stage_attribution"]
+
+
+def test_flight_events_zero_disables_recorder(tmp_path, rng):
+    model_dir, _ = _train_small_game(tmp_path, rng)
+    bad_in = tmp_path / "bad-input"
+    bad_in.mkdir()
+    (bad_in / "part-00000.avro").write_bytes(b"junk")
+    out = tmp_path / "score-norec"
+    with pytest.raises(Exception):
+        game_scoring_driver.run([
+            "--input-dirs", str(bad_in),
+            "--game-model-input-dir", str(model_dir),
+            "--output-dir", str(out),
+            "--flight-events", "0",
+        ])
+    assert not (out / "flight.json").exists()
+
+
+def test_stream_train_obs_heartbeat(tmp_path, rng):
+    """The training driver's opt-in plane: --stream-train --obs-port 0
+    is scrapeable while solving, and the 1 Hz heartbeat block lands in
+    metrics.json."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=240, d=40)
+    out = tmp_path / "game-out-obs"
+    out.mkdir()
+    results = {}
+    scraper = threading.Thread(
+        target=_scrape_while_alive, args=(out, results), daemon=True)
+    scraper.start()
+    summary = game_training_driver.run([
+        "--train-input-dirs", str(train),
+        "--output-dir", str(out),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:15,1e-7,1.0,1.0,LBFGS,L2",
+        "--updating-sequence", "fixed",
+        "--stream-train", "--batch-rows", "64", "--feeder", "python",
+        "--obs-port", "0",
+    ])
+    scraper.join(timeout=60)
+    assert "error" not in results
+    assert results.get("scrapes", 0) >= 1
+    parse_prometheus(results["metrics"])  # valid exposition, live
+    obs_block = summary["observability"]
+    assert obs_block["server"]["heartbeat_s"] == 1.0
+    assert obs_block["server"]["port"] == results["port"]
+    assert summary["stream_train"]["mode"] == "resident-assembled"
+    # the run's phases were visible to the plane (the final telemetry
+    # block's stage table covers the stream-train pipeline; the fused
+    # resident-path solver deliberately has no per-iteration counter)
+    stages = summary["telemetry"]["stage_attribution"]
+    assert "solve" in stages and "ingest" in stages
+
+
+@pytest.mark.needs_f64
+def test_scoring_metrics_json_includes_new_frontend_keys(tmp_path, rng):
+    """The per-model admission view is part of the stats()/statusz
+    schema now — present (empty maps, None quota) even when unused."""
+    model_dir, valid = _train_small_game(tmp_path, rng)
+    out = tmp_path / "score-serve-schema"
+    summary = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(model_dir),
+        "--output-dir", str(out),
+        "--serve", "--request-rows", "35", "--feeder", "python",
+    ])
+    fe = summary["frontend"]
+    assert fe["max_pending_per_model"] is None
+    assert fe["rejected_by_model"] == {}
+    assert fe["pending_by_model"] == {"default": 0}
+    assert fe["admitted"] == \
+        fe["completed"] + fe["failed"] + fe["cancelled"]
+    np.testing.assert_equal(fe["failed"], 0)

@@ -246,6 +246,115 @@ def test_score_during_close_is_refused_not_hung(frontend_and_model):
     assert asyncio.run(run()).shape == (1,)
 
 
+@pytest.mark.needs_f64
+def test_isolation_retry_does_not_overcount(rng):
+    """Regression (PR 8 docstring caveat, now fixed): a coalesce window
+    whose score_many spans SEVERAL internal dispatch groups fails on a
+    late group -> the solo retry used to re-count requests the failed
+    attempt had already counted. With the checkpoint/rollback the engine
+    counters equal the requests actually SERVED, and the registry obeys
+    admitted == completed + failed exactly."""
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    fe = ServingFrontend({"default": gm}, dtype=DT,
+                         ladder=BucketLadder(**LADDER),
+                         config=FrontendConfig(coalesce_window_s=0.25,
+                                               max_pending=256))
+    # 3x30-row requests + 1 malformed: inside ONE coalesce window the
+    # engine packs [30, 30] (60 <= max_rows=64) as dispatch group 1 and
+    # [30, bad] as group 2 — group 1 is counted AND dispatched before
+    # the bad request's featureization raises.
+    goods = [_dataset(np.random.default_rng(800 + i), n=30)
+             for i in range(3)]
+    bad = GameDataset.build(
+        responses=np.zeros(1),
+        feature_shards={"global": sp.csr_matrix(np.ones((1, 6)))},
+        ids={})  # missing 'user' shard and id columns
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        results, info = fe.replay(goods + [bad],
+                                  arrivals=[0.0] * 4)
+        assert info["errors"] == 1 and info["shed"] == 0
+        for r, o in zip(goods, results[:3]):
+            np.testing.assert_allclose(o, gm.score(r),
+                                       rtol=1e-10, atol=1e-10)
+        st = fe.stats()
+        assert st["isolation_splits"] == 1
+        # Engine accounting == requests actually served (3), not the
+        # 5 the double-count produced (2 in the failed attempt's
+        # completed group + 3 solo retries).
+        eng = st["engines"]["default"]
+        assert eng["requests"] == 3
+        assert eng["rows_scored"] == 90
+        snap = telemetry.snapshot()
+        assert snap["counters"]["serving.requests"] == 3
+        assert snap["counters"]["serving.model.default.requests"] == 3
+        assert snap["counters"]["serving.rows_scored"] == 90
+        # Conservation law on the front-end registry family.
+        c = snap["counters"]
+        assert c["serving.frontend.admitted"] == 4
+        assert c["serving.frontend.completed"] == 3
+        assert c["serving.frontend.failed"] == 1
+        assert (c["serving.frontend.completed"]
+                + c["serving.frontend.failed"]
+                == c["serving.frontend.admitted"])
+        assert st["admitted"] == st["completed"] + st["failed"] == 4
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+@pytest.mark.needs_f64
+def test_per_model_quota_protects_quiet_tenant(rng):
+    """Satellite: max_pending_per_model sheds the hot tenant at ITS
+    quota (typed rejection, scope='model', per-model rejected counters)
+    while a quiet tenant keeps admitting into the shared process
+    bound."""
+    train = _dataset(rng, n=80)
+    gm_a = _game_model(rng, train)
+    gm_b = _variant(gm_a, 2.0)
+    fe = ServingFrontend(
+        {"hot": gm_a, "quiet": gm_b}, dtype=DT,
+        ladder=BucketLadder(**LADDER),
+        config=FrontendConfig(coalesce_window_s=0.2, max_pending=64,
+                              max_pending_per_model=2))
+    reqs = _singles(900, 8)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+
+        async def run():
+            async with fe:
+                hot = [asyncio.ensure_future(fe.score(r, model="hot"))
+                       for r in reqs[:2]]
+                await asyncio.sleep(0)  # admit the hot pair
+                assert fe.stats()["pending_by_model"]["hot"] == 2
+                # Hot tenant at quota: typed per-model shed, process
+                # still has 62 slots of headroom.
+                with pytest.raises(RequestRejected) as ei:
+                    await fe.score(reqs[2], model="hot")
+                assert ei.value.scope == "model"
+                assert ei.value.model == "hot"
+                assert ei.value.pending == 2 and ei.value.limit == 2
+                # The quiet tenant is unaffected by the hot one's quota.
+                quiet = await fe.score(reqs[3], model="quiet")
+                return await asyncio.gather(*hot), quiet
+
+        hot_out, quiet_out = asyncio.run(run())
+        assert len(hot_out) == 2 and quiet_out is not None
+        st = fe.stats()
+        assert st["rejected"] == 1
+        assert st["rejected_by_model"] == {"hot": 1}
+        assert st["completed"] == 3 and st["admitted"] == 3
+        snap = telemetry.snapshot()
+        assert snap["counters"]["serving.model.hot.rejected"] == 1
+        assert "serving.model.quiet.rejected" not in snap["counters"]
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
 # -- multi-model tenancy ---------------------------------------------------
 
 @pytest.mark.needs_f64
