@@ -421,6 +421,63 @@ class TelemetryInTraceRule:
         return None
 
 
+class SpillDtypeLeakRule:
+    """The shard cache's compressed spill tier (data/shard_cache.py)
+    holds feature blocks as bf16 values + delta-encoded u8/u16 indices.
+    Those buffers are NOT device-kernel data: a `CSRFeatures` built from
+    them without the restore cast would silently jit-trace a second
+    executable per bucket (dtype is part of the signature) and
+    accumulate at the wrong precision — the sharded objective's kernels
+    are compiled for f32/i32 (ops/sharded_objective.py, restore-dtype
+    contract)."""
+
+    id = "spill-dtype-leak"
+    doc = ("spill-encoded buffers (.enc_values/.enc_cols/.enc_rows) "
+           "consumed outside data/shard_cache.py's "
+           "restore_spilled_features — bf16/delta data would leak into "
+           "device kernels un-restored")
+
+    #: SpillBlock's encoded fields — distinctive enough to flag on name.
+    _ATTRS = ("enc_values", "enc_cols", "enc_rows")
+    #: The blessed consumers, all in data/shard_cache.py: the codec
+    #: pair and SpillBlock's own byte accounting.
+    _ALLOWED_MODULE = "photon_ml_tpu/data/shard_cache.py"
+    _ALLOWED_FNS = ("encode_spill", "restore_spilled_features", "nbytes")
+
+    def check(self, mod: ModuleSource, project: Project) -> List[Violation]:
+        p = "/" + mod.path
+        if "/photon_ml_tpu/" not in p:
+            return []  # tests/bench poke the codec fields legitimately
+        allowed_module = p.endswith("/data/shard_cache.py")
+        out: List[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Attribute)
+                    and node.attr in self._ATTRS
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            if allowed_module and self._in_allowed_fn(mod, node):
+                continue
+            v = mod.violation(
+                node, self.id,
+                f".{node.attr} is a spill-ENCODED buffer (bf16 values / "
+                "delta-coded indices): consuming it outside "
+                "data/shard_cache.py restore_spilled_features leaks "
+                "non-f32 data into device kernels un-restored — "
+                "restore the block through the cache's miss path "
+                "instead")
+            if v is not None:
+                out.append(v)
+        return out
+
+    def _in_allowed_fn(self, mod: ModuleSource, node: ast.AST) -> bool:
+        fi = mod.fn_of.get(node)
+        while fi is not None:
+            if fi.name in self._ALLOWED_FNS:
+                return True
+            fi = fi.parent
+        return False
+
+
 class BlockingInAsyncRule:
     """The serving front-end's event loop IS the product: one blocking
     call inside a coroutine stalls ADMISSION for every connected
@@ -519,6 +576,7 @@ ALL_RULES = (
     DtypeDriftRule(),
     NondeterministicPytreeRule(),
     TelemetryInTraceRule(),
+    SpillDtypeLeakRule(),
     BlockingInAsyncRule(),
 )
 

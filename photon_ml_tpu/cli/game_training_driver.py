@@ -181,6 +181,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "Requires --stream-train; N > 1 additionally "
                         "requires --hbm-budget. N=1 is exactly the "
                         "single-device fold")
+    p.add_argument("--spill-dtype", choices=["f32", "bf16"],
+                   default="f32",
+                   help="--hbm-budget spill-buffer encoding: 'f32' "
+                        "(default) spills evicted feature blocks as the "
+                        "raw padded f32/i32 triplet (re-uploads are the "
+                        "evicted bytes — today's bitwise guarantees); "
+                        "'bf16' spills bfloat16 values + delta-encoded "
+                        "u8/u16 indices (~1/3 of the f32 spill bytes "
+                        "AND per-epoch re-upload traffic; restore "
+                        "decodes back to f32 on device, with documented "
+                        "parity bounds vs the f32-spill model — "
+                        "docs/SCALE.md)")
+    p.add_argument("--spill-source", choices=["buffer", "redecode"],
+                   default="buffer",
+                   help="where evicted --hbm-budget blocks come back "
+                        "from: 'buffer' (default) re-uploads host spill "
+                        "buffers (host RAM O(dataset)); 'redecode' "
+                        "keeps NO host copy — cache misses re-decode "
+                        "the covering Avro container blocks "
+                        "(prefetch-overlapped with the accumulate), so "
+                        "host memory is O(budget + one block) and "
+                        "trainable size is disk-bounded")
     p.add_argument("--feeder", choices=["auto", "native", "python"],
                    default="auto",
                    help="--stream-train decode path (see "
@@ -340,6 +362,21 @@ def _run_training(args, logger, task, emitter):
             "--mesh-devices > 1 requires --hbm-budget: the device fold "
             "runs over the sharded shard-cache solve (the resident "
             "assembled path is a single fused device batch)")
+    if args.spill_dtype != "f32" and args.hbm_budget is None:
+        raise ValueError(
+            "--spill-dtype applies to --hbm-budget spill buffers; pass "
+            "--stream-train --hbm-budget (the resident assembled path "
+            "never spills)")
+    if args.spill_source != "buffer" and args.hbm_budget is None:
+        raise ValueError(
+            "--spill-source applies to --hbm-budget eviction; pass "
+            "--stream-train --hbm-budget (the resident assembled path "
+            "never evicts)")
+    if args.spill_source == "redecode" and args.spill_dtype != "f32":
+        raise ValueError(
+            "--spill-dtype bf16 compresses host spill buffers, but "
+            "--spill-source redecode keeps none — the combination "
+            "would silently train as f32; pick one")
 
     if args.stream_train:
         if re_data or fre_data or len(sequence) != 1 \
@@ -640,6 +677,8 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "batch_rows": args.batch_rows,
             "hbm_budget_bytes": None,
             "mesh_devices": args.mesh_devices,
+            "spill_dtype": None,  # nothing spills on the resident path
+            "spill_source": None,
             "feeder": {k: v for k, v in data.ingest_stats.items()},
             "cache": None,
         }
@@ -652,16 +691,29 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
 
             mesh = make_mesh(args.mesh_devices)
             devices = mesh_device_list(mesh)
-        logger.info("stream-train (spill, hbm budget %d bytes%s): caching "
-                    "%r from %s in %d-row shards", budget,
+        logger.info("stream-train (spill, hbm budget %d bytes%s, "
+                    "spill %s/%s): caching %r from %s in %d-row shards",
+                    budget,
                     (f" PER DEVICE x {len(devices)} mesh devices"
-                     if devices else ""), shard, train_inputs,
+                     if devices else ""), args.spill_dtype,
+                    args.spill_source, shard, train_inputs,
                     args.batch_rows)
+        fetcher = None
+        if args.spill_source == "redecode":
+            from photon_ml_tpu.data.block_stream import BlockRandomAccess
+
+            # The out-of-core miss path: evicted blocks re-decode their
+            # covering container blocks by global row range instead of
+            # re-uploading host spill buffers.
+            fetcher = BlockRandomAccess(
+                train_inputs, id_types=[], feature_shard_maps=shard_maps,
+                feeder=args.feeder)
         with span("ingest"):
             cache = DeviceShardCache.from_stream(
                 make_stream(), shard, hbm_budget_bytes=budget,
                 prefetch_depth=max(0, args.prefetch_batches),
-                devices=devices)
+                devices=devices, spill_dtype=args.spill_dtype,
+                spill_source=args.spill_source, redecode_fetch=fetcher)
         results = []
         shared = None
         with span("solve"):
@@ -689,11 +741,20 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "batch_rows": args.batch_rows,
             "hbm_budget_bytes": budget,
             "mesh_devices": args.mesh_devices,
+            "spill_dtype": args.spill_dtype,
+            "spill_source": args.spill_source,
             "feeder": cache.ingest_stats,
             "cache": cache.stats(),
             "trace_budgets": shared.trace_budgets(),
             "trace_counts": shared.guard.counts(),
         }
+        if fetcher is not None:
+            stream_info["redecode"] = {
+                "decode_path": fetcher.decode_path,
+                "payload_bytes_read": fetcher.payload_bytes_read,
+                "blocks_decoded": fetcher.blocks_decoded,
+                "rows_fetched": fetcher.rows_fetched,
+            }
 
     if args.validate_input_dirs and evaluators:
         with span("validate"):

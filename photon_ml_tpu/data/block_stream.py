@@ -61,6 +61,26 @@ from photon_ml_tpu.data.shard_planner import (
 FEEDERS = ("auto", "native", "python")
 
 
+def _native_layouts(indexes, id_types):
+    """Compile one native decode layout per file index. Returns
+    ``(layouts, None)`` on success or ``([], reason)`` when any file's
+    schema cannot decode natively — shared by the sequential stream and
+    the random-access fetch so both resolve the C path identically."""
+    from photon_ml_tpu.data.fast_ingest import build_training_layout
+    from photon_ml_tpu.io.avro_codec import Schema
+
+    layouts = []
+    for ix in indexes:
+        layout = build_training_layout(Schema(ix.schema_json).root)
+        if layout is None:
+            return [], (f"{ix.path}: schema does not fit the native "
+                        "training layout")
+        if id_types and not layout.has_metadata:
+            return [], f"{ix.path}: id types requested but no metadataMap"
+        layouts.append(layout)
+    return layouts, None
+
+
 def _load_native():
     from photon_ml_tpu.native import load_avro_native
 
@@ -231,19 +251,9 @@ class BlockGameStream:
         """Layout per file (aligned with self._indexes); returns a reason
         string when any file's schema can't decode natively, None on
         success."""
-        from photon_ml_tpu.data.fast_ingest import build_training_layout
-        from photon_ml_tpu.io.avro_codec import Schema
-
-        self._layouts = []
-        for ix in self._indexes:
-            layout = build_training_layout(Schema(ix.schema_json).root)
-            if layout is None:
-                return (f"{ix.path}: schema does not fit the native "
-                        "training layout")
-            if self._id_types and not layout.has_metadata:
-                return f"{ix.path}: id types requested but no metadataMap"
-            self._layouts.append(layout)
-        return None
+        self._layouts, why = _native_layouts(self._indexes,
+                                             self._id_types)
+        return why
 
     # -- iteration ---------------------------------------------------------
 
@@ -343,6 +353,174 @@ class BlockGameStream:
             "peak_resident_batches": self.peak_resident_batches,
             "decode_seconds": self.decode_seconds,
         }
+
+
+class BlockRandomAccess:
+    """Random-access re-decode of container rows by GLOBAL row range —
+    the miss path of the shard cache's fully out-of-core ``redecode``
+    spill tier (data/shard_cache.py): evicted feature blocks keep NO
+    host copy, and a cache miss re-decodes exactly the Avro container
+    blocks that cover the requested rows through the same block index
+    the sequential stream uses (`shard_planner.scan_container_blocks`).
+
+    ``fetch_rows(row_start, n_rows)`` returns a GameDataset
+    byte-identical to the ``BlockGameStream`` batch that covered rows
+    ``[row_start, row_start + n_rows)`` at ingest, for the same maps /
+    id types / intercept settings: the native path feeds the covering
+    blocks through the same `_ColumnBuffer` cut, the python path feeds
+    the covering records through the same `_GameBatchBuilder` — the two
+    batch-construction code paths whose byte-identity
+    tests/test_block_stream.py already pins.
+
+    Cost per fetch: the covering container blocks are re-read from disk
+    and re-decoded (a batch spans ceil(batch_rows / block_rows) + 1
+    blocks); nothing else is touched, so host residency is O(one
+    fetch). Instances keep cumulative ``payload_bytes_read`` /
+    ``blocks_decoded`` / ``rows_fetched`` — the shard cache reads the
+    payload-byte deltas into its ``bytes_redecoded`` telemetry.
+    Instances are callable (``fetch(row_start, n_rows)``) so the cache
+    can hold them as a plain hook."""
+
+    def __init__(self, path, id_types: Sequence[str],
+                 feature_shard_maps: Dict[str, IndexMap],
+                 add_intercept: bool = True, feeder: str = "auto"):
+        if feeder not in FEEDERS:
+            raise ValueError(f"feeder must be one of {FEEDERS}, "
+                             f"got {feeder!r}")
+        self._id_types = tuple(id_types)
+        self._maps = dict(feature_shard_maps)
+        self._add_intercept = add_intercept
+        self._indexes = scan_paths(_avro_paths(path))
+        self.decode_path = "python"
+        native = None if feeder == "python" else _load_native()
+        why = "native decoder unavailable"
+        self._layouts: list = []
+        if native is not None:
+            self._layouts, why = _native_layouts(self._indexes,
+                                                 self._id_types)
+            if why is None:
+                self.decode_path = "native"
+        if feeder == "native" and self.decode_path != "native":
+            raise RuntimeError(
+                f"feeder='native' requested but the C block path does "
+                f"not apply: {why}")
+        self._native = native if self.decode_path == "native" else None
+        self._schemas: dict = {}  # file idx -> parsed python schema root
+
+        # Flattened (file idx, BlockSpan, global first row) table +
+        # bisectable row starts: fetch maps a row range to the covering
+        # block run in O(log blocks).
+        self._blocks: list = []
+        row = 0
+        for fi, ix in enumerate(self._indexes):
+            for b in ix.blocks:
+                self._blocks.append((fi, b, row))
+                row += b.count
+        self.total_rows = row
+        self._row_starts = [entry[2] for entry in self._blocks]
+        self.payload_bytes_read = 0
+        self.blocks_decoded = 0
+        self.rows_fetched = 0
+
+    def __call__(self, row_start: int, n_rows: int) -> GameDataset:
+        return self.fetch_rows(row_start, n_rows)
+
+    def _covering_blocks(self, row_start: int, n_rows: int):
+        """Yield (file idx, BlockSpan) for the minimal block run
+        covering the row range, reading each payload as it is needed."""
+        import bisect
+
+        first = bisect.bisect_right(self._row_starts, row_start) - 1
+        need_until = row_start + n_rows
+        i = first
+        f = None
+        cur_file = None
+        try:
+            while i < len(self._blocks) \
+                    and self._blocks[i][2] < need_until:
+                fi, b, _ = self._blocks[i]
+                ix = self._indexes[fi]
+                if fi != cur_file:
+                    if f is not None:
+                        f.close()
+                    f = open(ix.path, "rb")
+                    f.seek(b.offset)
+                    cur_file = fi
+                _, payload = read_block(
+                    f, ix.codec, ix.sync, ix.path,
+                    expected=(b.count, b.payload_bytes, b.offset))
+                self.payload_bytes_read += b.payload_bytes
+                self.blocks_decoded += 1
+                yield fi, b, payload
+                i += 1
+        finally:
+            if f is not None:
+                f.close()
+
+    def fetch_rows(self, row_start: int, n_rows: int) -> GameDataset:
+        """Decode rows ``[row_start, row_start + n_rows)`` — see class
+        docstring for the byte-identity contract."""
+        if n_rows < 1:
+            raise ValueError(f"n_rows must be >= 1, got {n_rows}")
+        if row_start < 0 or row_start + n_rows > self.total_rows:
+            raise ValueError(
+                f"row range [{row_start}, {row_start + n_rows}) outside "
+                f"the container ({self.total_rows} rows)")
+        import bisect
+
+        first = bisect.bisect_right(self._row_starts, row_start) - 1
+        skip = row_start - self._blocks[first][2]
+        self.rows_fetched += n_rows
+        if self.decode_path == "native":
+            return self._fetch_native(row_start, n_rows, skip)
+        return self._fetch_python(row_start, n_rows, skip)
+
+    def _fetch_native(self, row_start: int, n_rows: int,
+                      skip: int) -> GameDataset:
+        shard_names = list(self._maps)
+        dicts_t = tuple(self._maps[s].key_to_index_dict()
+                        for s in shard_names)
+        icepts_t = tuple(
+            int(self._maps[s].intercept_index if self._add_intercept
+                else -1)
+            for s in shard_names)
+        buf = _ColumnBuffer(self._maps, self._id_types)
+        for fi, b, payload in self._covering_blocks(row_start, n_rows):
+            layout = self._layouts[fi]
+            try:
+                decoded = self._native.decode_training_block(
+                    payload, b.count, layout.prog, layout.layout,
+                    dicts_t, icepts_t, self._id_types, DELIMITER, None)
+            except ValueError as e:
+                raise ValueError(
+                    f"{self._indexes[fi].path}: block at offset "
+                    f"{b.offset} failed to decode: {e}") from e
+            buf.put_block(decoded, b.count, layout)
+        if skip:
+            buf.take(skip)  # discard the head of the first block
+        return buf.take(n_rows)
+
+    def _fetch_python(self, row_start: int, n_rows: int,
+                      skip: int) -> GameDataset:
+        import io as _io
+
+        from photon_ml_tpu.io.avro_codec import Schema, read_datum
+
+        batch = _GameBatchBuilder(self._maps, self._id_types,
+                                  self._add_intercept)
+        pos = 0  # record position relative to the first covering block
+        for fi, b, payload in self._covering_blocks(row_start, n_rows):
+            root = self._schemas.get(fi)
+            if root is None:
+                root = Schema(self._indexes[fi].schema_json).root
+                self._schemas[fi] = root
+            src = _io.BytesIO(payload)
+            for _ in range(b.count):
+                rec = read_datum(src, root)
+                if skip <= pos < skip + n_rows:
+                    batch.append(rec)
+                pos += 1
+        return batch.build()
 
 
 def read_game_dataset_via_blocks(

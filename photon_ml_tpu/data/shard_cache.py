@@ -32,6 +32,41 @@ rows land on device in one of two regimes —
   overlaps the accumulate of shard k (the same three-stage pipeline
   shape as streamed scoring).
 
+The spill tier itself has two knobs (Snap ML's hierarchical memory
+tiers, PAPERS.md — compressed / recomputed lower tiers are what make
+trainable size disk-bounded):
+
+- ``spill_dtype`` — what the host spill buffers hold. ``"f32"``
+  (default) keeps the PR-5 raw padded f32/i32/i32 triplet: re-uploads
+  are literally the evicted bytes, so every bitwise replay guarantee
+  holds unchanged. ``"bf16"`` spills values as bfloat16 and indices
+  DELTA-ENCODED to u8/u16 (`encode_spill`: column ids re-based per row,
+  row ids as non-negative diffs; either stream falls back to raw i32
+  when a delta overflows or is negative), cutting spill bytes AND
+  per-epoch H2D re-upload traffic to ~1/3-1/2 of f32. Restore
+  (`restore_spilled_features`) decodes ON DEVICE — upload is the
+  compact encoding; a per-bucket jitted kernel widens bf16 -> f32 and
+  un-deltas the indices — so the `CSRFeatures` handed to the sharded
+  objective is f32/i32 exactly as before: the accumulate kernels'
+  dtype contract is untouched (index bits are EXACTLY the evicted
+  ones; values round-trip through bf16 with documented parity bounds,
+  docs/SCALE.md §Training memory envelope). Values are quantized ONCE
+  AT INGEST — never-evicted blocks take the same bf16 round trip — so
+  a bf16 replay is deterministic and residency-independent just like
+  f32; only the value PRECISION differs from the f32-spill model.
+- ``spill_source`` — where evicted blocks come back from.
+  ``"buffer"`` (default) re-uploads host spill buffers (host RAM stays
+  O(dataset) — f32 or ~1/3 of that for bf16). ``"redecode"`` keeps NO
+  host copy: evicted blocks are dropped and a cache miss re-decodes
+  the Avro container blocks that produced the batch through a
+  `BlockRandomAccess` (data/block_stream.py) row-range fetch — host
+  memory falls to O(budget + one block) and trainable dataset size is
+  bounded only by disk. The re-decoded batch is byte-identical to the
+  ingest-time batch (the block cut is deterministic), so the padded
+  triplet — and every partial — is bit-for-bit the resident replay.
+  Misses run inside the `blocks()` prefetch thread, so the re-decode
+  of shard k+1 overlaps the accumulate of shard k.
+
 With ``devices`` (a 1-D mesh's device list, ``--mesh-devices``), blocks
 place ROUND-ROBIN over the devices — block i is committed to
 ``devices[i % D]``, spill re-uploads return to the same device, and
@@ -52,7 +87,8 @@ dataset, partials combine in a fixed deterministic order.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Iterator, List, Optional
+import functools
+from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -73,15 +109,191 @@ _M_HITS = telemetry.counter("data.shard_cache.hits")
 _M_MISSES = telemetry.counter("data.shard_cache.misses")
 _M_EVICTIONS = telemetry.counter("data.shard_cache.evictions")
 _M_REUPLOAD_BYTES = telemetry.counter("data.shard_cache.bytes_reuploaded")
+_M_SPILL_WRITTEN = telemetry.counter("data.shard_cache.spill_bytes_written")
+_M_REDECODE_BYTES = telemetry.counter("data.shard_cache.bytes_redecoded")
 _M_EPOCHS = telemetry.counter("data.shard_cache.epochs")
 _G_DEVICE_BYTES = telemetry.gauge("data.shard_cache.device_bytes")
 _G_PEAK_BYTES = telemetry.gauge("data.shard_cache.peak_device_bytes")
+# Host-side spill residency: the O(dataset) cost that device_bytes/peak
+# never showed (metrics.json twin: stream_train.cache.spill_bytes_host).
+_G_SPILL_HOST = telemetry.gauge("data.shard_cache.spill_bytes_host")
+
+SPILL_DTYPES = ("f32", "bf16")
+SPILL_SOURCES = ("buffer", "redecode")
 
 
 def _row_ids_i32(indptr: np.ndarray, offset: int = 0) -> np.ndarray:
     n = len(indptr) - 1
     return (np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
             + offset).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Spill codecs: compressed host buffers + on-device restore to f32/i32
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpillBlock:
+    """Host spill record of one evicted feature block.
+
+    All three arrays are PADDED to ``nnz_bucket`` (pad entries are
+    zeros), so restore H2D transfers keep the static bucket shape the
+    jitted decode kernel compiles for. Encodings per ``dtype_tag``:
+
+    - ``"f32"``: the raw PR-5 triplet — ``enc_values`` f32,
+      ``enc_cols``/``enc_rows`` i32. Restore re-uploads them verbatim
+      (bitwise the evicted bytes).
+    - ``"bf16"``: ``enc_values`` bfloat16 (round-to-nearest-even of the
+      f32 values); ``enc_cols`` u8/u16 per-row delta codes (absolute
+      column at each row start, positive within-row diffs after — CSR
+      canonicalization guarantees sorted, duplicate-free columns);
+      ``enc_rows`` u8/u16 non-negative diffs of the non-decreasing row
+      ids. Either index stream independently falls back to raw i32
+      when a delta overflows its widest unsigned code (or a
+      non-canonical input produces a negative delta).
+
+    The ``enc_*`` fields are ONLY consumed by
+    :func:`restore_spilled_features` — anywhere else they would leak
+    bf16/delta-encoded data into device kernels (enforced by the
+    jaxlint ``spill-dtype-leak`` rule, docs/ANALYSIS.md).
+    """
+
+    nnz: int  # true entries; [nnz, nnz_bucket) is padding
+    enc_values: np.ndarray
+    enc_cols: np.ndarray
+    enc_rows: np.ndarray
+    dtype_tag: str  # "f32" | "bf16"
+
+    @property
+    def nbytes(self) -> int:
+        return (self.enc_values.nbytes + self.enc_cols.nbytes
+                + self.enc_rows.nbytes)
+
+
+def _shrink_deltas(deltas: np.ndarray, raw: np.ndarray,
+                   pad_to: int) -> np.ndarray:
+    """Pick the narrowest unsigned code that holds every delta; when a
+    delta is negative or exceeds u16, fall back to the RAW i32 ids
+    (decode then skips the cumulative reconstruction entirely)."""
+    lo = int(deltas.min()) if len(deltas) else 0
+    hi = int(deltas.max()) if len(deltas) else 0
+    if lo < 0 or hi > np.iinfo(np.uint16).max:
+        out = np.zeros(pad_to, np.int32)
+        out[:len(raw)] = raw
+        return out
+    code = np.uint8 if hi <= np.iinfo(np.uint8).max else np.uint16
+    out = np.zeros(pad_to, code)
+    out[:len(deltas)] = deltas
+    return out
+
+
+def encode_spill(values: np.ndarray, cols: np.ndarray, rows: np.ndarray,
+                 nnz: int, spill_dtype: str) -> SpillBlock:
+    """Padded f32/i32/i32 triplet -> host spill record (see SpillBlock).
+
+    ``values/cols/rows`` are the padded ingest arrays
+    (`padded_csr_arrays`); ``nnz`` is the true entry count. The f32 tag
+    stores them as-is (zero-copy — today's spill, bit for bit)."""
+    if spill_dtype not in SPILL_DTYPES:
+        raise ValueError(
+            f"spill_dtype must be one of {SPILL_DTYPES}, got "
+            f"{spill_dtype!r}")
+    if spill_dtype == "f32":
+        return SpillBlock(nnz=nnz, enc_values=values, enc_cols=cols,
+                          enc_rows=rows, dtype_tag="f32")
+    import ml_dtypes
+
+    pad_to = len(values)
+    ev = np.zeros(pad_to, ml_dtypes.bfloat16)
+    ev[:nnz] = values[:nnz].astype(ml_dtypes.bfloat16)
+    c = cols[:nnz].astype(np.int64)
+    r = rows[:nnz].astype(np.int64)
+    cd = c.copy()
+    cd[1:] -= c[:-1]
+    if nnz:
+        # Absolute column at each row start (the first entry is one).
+        starts = np.empty(nnz, bool)
+        starts[0] = True
+        starts[1:] = r[1:] != r[:-1]
+        cd[starts] = c[starts]
+    rd = r.copy()
+    rd[1:] -= r[:-1]
+    return SpillBlock(
+        nnz=nnz, enc_values=ev,
+        enc_cols=_shrink_deltas(cd, cols[:nnz], pad_to),
+        enc_rows=_shrink_deltas(rd, rows[:nnz], pad_to),
+        dtype_tag="bf16")
+
+
+def _decode_spill_impl(values, col_enc, row_enc, nnz):
+    """Device-side spill decode: widen values to f32, un-delta the
+    index streams, zero the pad tail. Traced per (nnz_bucket, encoding
+    dtypes); ``nnz`` is a TRACED i32 scalar, so varying true nnz never
+    recompiles. Raw-i32 fallback streams skip reconstruction (the
+    dtype is part of the trace signature, so the branch is static)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = values.shape[0]
+    pos = lax.iota(jnp.int32, n)
+    live = pos < nnz
+    vals = jnp.where(live, values.astype(jnp.float32),
+                     jnp.zeros((), jnp.float32))
+    if row_enc.dtype == jnp.int32:
+        rows = row_enc
+    else:
+        rows = jnp.cumsum(row_enc.astype(jnp.int32))
+    if col_enc.dtype == jnp.int32:
+        cols = col_enc
+    else:
+        d = col_enc.astype(jnp.int32)
+        cum = jnp.cumsum(d)
+        start = jnp.concatenate(
+            [jnp.ones((1,), bool), rows[1:] != rows[:-1]])
+        base = cum - d  # prefix sum before each element
+        # Bases at row starts are non-decreasing (deltas >= 0), so a
+        # running max propagates each segment's re-base forward.
+        corr = lax.cummax(jnp.where(start, base, 0))
+        cols = cum - corr
+    zero = jnp.zeros((), jnp.int32)
+    return (vals, jnp.where(live, cols, zero).astype(jnp.int32),
+            jnp.where(live, rows, zero).astype(jnp.int32))
+
+
+@functools.lru_cache(maxsize=1)
+def _decode_spill_jit():
+    """One process-wide jitted decode (built on first spill restore so
+    importing this module never imports jax); the jit cache keys on
+    (nnz_bucket, encoding dtypes) — true nnz is a traced argument."""
+    import jax
+
+    return jax.jit(_decode_spill_impl)
+
+
+def restore_spilled_features(spill: SpillBlock, rows_bucket: int,
+                             n_features: int, device) -> CSRFeatures:
+    """The ONE blessed spill -> device path: re-upload (compact bytes on
+    the wire) and restore to the f32/i32 `CSRFeatures` the sharded
+    objective's kernels were compiled for. f32 spill re-uploads the
+    evicted bytes verbatim; bf16 spill uploads the encodings and
+    decodes on device (`_decode_spill_impl`)."""
+    import jax
+    import jax.numpy as jnp
+
+    def idx(x):
+        return (jnp.asarray(x) if device is None
+                else jax.device_put(x, device))
+
+    if spill.dtype_tag == "f32":
+        return CSRFeatures(
+            chunked_device_put(spill.enc_values, device=device),
+            idx(spill.enc_cols), idx(spill.enc_rows),
+            rows_bucket, n_features)
+    vals, cols, rows = _decode_spill_jit()(
+        idx(spill.enc_values), idx(spill.enc_cols), idx(spill.enc_rows),
+        idx(np.int32(spill.nnz)))
+    return CSRFeatures(vals, cols, rows, rows_bucket, n_features)
 
 
 # ---------------------------------------------------------------------------
@@ -214,8 +426,9 @@ class CachedShard:
     with weight-0 rows) are ALWAYS device-resident — they are the cheap
     4-bytes-per-row part, and keeping them resident is what makes the
     margin-cached line search feature-pass-free. The FEATURE triplet
-    (``feats``) is the evictable part; ``host_values/cols/rows`` are the
-    spill buffers it re-uploads from."""
+    (``feats``) is the evictable part; ``spill`` is the host record it
+    restores from (None in the ``redecode`` tier, where a miss re-decodes
+    the source Avro rows instead)."""
 
     index: int
     n_rows: int  # true rows (<= rows_bucket)
@@ -226,17 +439,21 @@ class CachedShard:
     labels: object  # device f[rows_bucket]
     offsets: object
     weights: object
-    host_values: Optional[np.ndarray]  # f32[nnz_bucket] spill buffer
-    host_cols: Optional[np.ndarray]  # i32[nnz_bucket]
-    host_rows: Optional[np.ndarray]  # i32[nnz_bucket] (block-local)
+    spill: Optional[SpillBlock]  # host spill record; None = no host copy
     feats: Optional[CSRFeatures] = None  # None = spilled
     device: object = None  # mesh placement; None = default device
     slot: int = 0  # mesh slot (index % n_devices); 0 without a mesh
 
     @property
     def feature_bytes(self) -> int:
-        # values f32 + col_ids i32 + row_ids i32, at the padded shape.
+        # Device-resident cost: values f32 + col_ids i32 + row_ids i32,
+        # at the padded shape (restore always widens back to f32/i32).
         return 12 * self.nnz_bucket
+
+    @property
+    def spill_bytes(self) -> int:
+        # Host-resident cost of the spill record (0 for redecode).
+        return 0 if self.spill is None else self.spill.nbytes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -289,7 +506,31 @@ class DeviceShardCache:
                  hbm_budget_bytes: Optional[int] = None,
                  prefetch_depth: int = 2,
                  ingest_stats: Optional[dict] = None,
-                 devices: Optional[List] = None):
+                 devices: Optional[List] = None,
+                 spill_dtype: str = "f32",
+                 spill_source: str = "buffer",
+                 shard_id: Optional[str] = None,
+                 redecode_fetch: Optional[Callable] = None):
+        if spill_dtype not in SPILL_DTYPES:
+            raise ValueError(
+                f"spill_dtype must be one of {SPILL_DTYPES}, got "
+                f"{spill_dtype!r}")
+        if spill_source not in SPILL_SOURCES:
+            raise ValueError(
+                f"spill_source must be one of {SPILL_SOURCES}, got "
+                f"{spill_source!r}")
+        if spill_source == "redecode" and spill_dtype != "f32":
+            raise ValueError(
+                f"spill_dtype={spill_dtype!r} compresses host spill "
+                "buffers, but spill_source='redecode' keeps none — the "
+                "combination would silently train as f32 while "
+                "reporting bf16; pick one")
+        if spill_source == "redecode" and hbm_budget_bytes is not None \
+                and redecode_fetch is None:
+            raise ValueError(
+                "spill_source='redecode' needs a redecode_fetch "
+                "callable (BlockRandomAccess.fetch_rows) to re-decode "
+                "evicted blocks from")
         self._entries = entries
         self.n_rows = int(n_rows)
         self.n_features = int(n_features)
@@ -297,8 +538,14 @@ class DeviceShardCache:
         self.hbm_budget_bytes = hbm_budget_bytes
         self.prefetch_depth = max(0, int(prefetch_depth))
         self.ingest_stats = dict(ingest_stats or {})
+        self.spill_dtype = spill_dtype
+        self.spill_source = spill_source
+        self._shard_id = shard_id
+        self._redecode_fetch = redecode_fetch
         self._stats = {"hits": 0, "misses": 0, "evictions": 0,
-                       "bytes_reuploaded": 0, "epochs": 0}
+                       "bytes_reuploaded": 0, "epochs": 0,
+                       "spill_bytes_written": 0, "redecodes": 0,
+                       "bytes_redecoded": 0}
         # A 1-device "mesh" is the single-pool cache: `devices` is only
         # recorded (and placement/budget split per device) for >= 2.
         self.devices = (list(devices)
@@ -312,7 +559,16 @@ class DeviceShardCache:
         self.peak_device_bytes = self.device_bytes
         if hbm_budget_bytes is None:
             for e in entries:
-                e.host_values = e.host_cols = e.host_rows = None
+                e.spill = None
+        _G_SPILL_HOST.set(self.spill_bytes_host)
+
+    @property
+    def spill_bytes_host(self) -> int:
+        """Host bytes retained by spill records across all shards — the
+        cost that is O(dataset) for ``buffer`` spill (f32, or ~1/3 for
+        bf16) and 0 for ``redecode``. Constant after ingest: buffers
+        are written once and retained regardless of residency."""
+        return sum(e.spill_bytes for e in self._entries)
 
     @property
     def device_bytes(self) -> int:
@@ -327,7 +583,11 @@ class DeviceShardCache:
                     hbm_budget_bytes: Optional[int] = None,
                     min_rows_bucket: int = 16,
                     prefetch_depth: int = 2,
-                    devices: Optional[List] = None) -> "DeviceShardCache":
+                    devices: Optional[List] = None,
+                    spill_dtype: str = "f32",
+                    spill_source: str = "buffer",
+                    redecode_fetch: Optional[Callable] = None
+                    ) -> "DeviceShardCache":
         """Ingest pass: decode (prefetched, via the stream) -> pad to the
         bucket ladder -> upload. Decode of batch k+1 overlaps the H2D of
         batch k (device_put is async; the stream's prefetch thread keeps
@@ -338,10 +598,33 @@ class DeviceShardCache:
         bytes stay O(budget + one block) and the resident set ends as a
         stable PREFIX of the shard order. ``devices`` (>= 2) places
         block i on ``devices[i % D]`` and makes the budget (and the
-        evict-as-you-go accounting) per device."""
+        evict-as-you-go accounting) per device.
+
+        ``spill_dtype``/``spill_source`` pick the spill tier (module
+        docstring): compressed host buffers (``bf16``) and/or no host
+        buffers at all (``redecode``, with ``redecode_fetch`` the
+        row-range re-decode hook — `BlockRandomAccess.fetch_rows`)."""
         import jax
         import jax.numpy as jnp
 
+        if spill_dtype not in SPILL_DTYPES:
+            raise ValueError(
+                f"spill_dtype must be one of {SPILL_DTYPES}, got "
+                f"{spill_dtype!r}")
+        if spill_source not in SPILL_SOURCES:
+            raise ValueError(
+                f"spill_source must be one of {SPILL_SOURCES}, got "
+                f"{spill_source!r}")
+        if spill_source == "redecode" and spill_dtype != "f32":
+            # Fail BEFORE the ingest pass: compressed buffers and
+            # no-buffers are mutually exclusive tiers (the combination
+            # would silently train as f32 while reporting bf16).
+            raise ValueError(
+                f"spill_dtype={spill_dtype!r} compresses host spill "
+                "buffers, but spill_source='redecode' keeps none — "
+                "pick one")
+        keep_buffers = (hbm_budget_bytes is not None
+                        and spill_source == "buffer")
         devs = (list(devices)
                 if devices is not None and len(devices) > 1 else None)
         n_slots = len(devs) if devs else 1
@@ -352,6 +635,7 @@ class DeviceShardCache:
         slot_bytes = [0] * n_slots
         peak_bytes = 0
         evictions = 0
+        spill_written = 0
         for ds in stream:
             if ds.num_rows == 0:
                 continue
@@ -368,6 +652,12 @@ class DeviceShardCache:
             with span("shard_upload"):
                 values, cols, rows = padded_csr_arrays(
                     mat, rb, nb, value_dtype=dtype)
+                spill = None
+                if keep_buffers:
+                    spill = encode_spill(values, cols, rows,
+                                         int(mat.nnz), spill_dtype)
+                    spill_written += spill.nbytes
+                    _M_SPILL_WRITTEN.inc(spill.nbytes)
 
                 def col(x):
                     out = np.zeros(rb, dtype)
@@ -379,16 +669,28 @@ class DeviceShardCache:
                     return (jnp.asarray(x) if dev is None
                             else jax.device_put(x, dev))
 
+                if spill is not None and spill.dtype_tag != "f32":
+                    # Lossy spill encodings quantize AT INGEST: every
+                    # block's device values take the same encode->
+                    # restore round trip whether or not it ever spills,
+                    # so bf16 replays stay deterministic AND residency-
+                    # independent (a path-dependent precision profile —
+                    # resident blocks f32, once-evicted blocks bf16 —
+                    # would make model bits depend on eviction history).
+                    feats = restore_spilled_features(spill, rb, int(d),
+                                                     dev)
+                else:
+                    feats = CSRFeatures(
+                        chunked_device_put(values, device=dev), idx(cols),
+                        idx(rows), rb, int(d))
                 e = CachedShard(
                     index=len(entries), n_rows=ds.num_rows,
                     nnz=int(mat.nnz), rows_bucket=rb, nnz_bucket=nb,
                     row_offset=n_rows,
                     labels=col(ds.responses), offsets=col(ds.offsets),
                     weights=col(ds.weights),
-                    host_values=values, host_cols=cols, host_rows=rows,
-                    feats=CSRFeatures(
-                        chunked_device_put(values, device=dev), idx(cols),
-                        idx(rows), rb, int(d)),
+                    spill=spill,
+                    feats=feats,
                     device=dev, slot=slot,
                 )
             entries.append(e)
@@ -412,8 +714,11 @@ class DeviceShardCache:
         cache = cls(entries, n_rows, int(d), dtype,
                     hbm_budget_bytes=hbm_budget_bytes,
                     prefetch_depth=prefetch_depth,
-                    ingest_stats=stream.stats(), devices=devs)
+                    ingest_stats=stream.stats(), devices=devs,
+                    spill_dtype=spill_dtype, spill_source=spill_source,
+                    shard_id=shard_id, redecode_fetch=redecode_fetch)
         cache._stats["evictions"] += evictions
+        cache._stats["spill_bytes_written"] += spill_written
         cache.peak_device_bytes = max(cache.peak_device_bytes, peak_bytes)
         if hbm_budget_bytes is not None:
             # The final block stayed pinned during ingest; settle to the
@@ -471,38 +776,71 @@ class DeviceShardCache:
                 _M_EVICTIONS.inc()
         _G_DEVICE_BYTES.set(self.device_bytes)
 
-    def ensure(self, index: int) -> ResidentBlock:
-        """Return a resident snapshot of the block, re-uploading the
-        spill buffers on a miss (async put — the caller overlaps it with
-        whatever it is accumulating)."""
-        import jax
-        import jax.numpy as jnp
+    def _redecode(self, e: CachedShard) -> CSRFeatures:
+        """redecode-tier miss: re-decode the block's source rows through
+        the random-access block fetch, re-pad, re-upload. The fetched
+        batch is byte-identical to the ingest-time batch (deterministic
+        block cut), so the padded triplet — hence every partial — is
+        bit-for-bit the resident replay."""
+        fetch = self._redecode_fetch
+        before = getattr(fetch, "payload_bytes_read", None)
+        with span("shard_redecode"):
+            ds = fetch(e.row_offset, e.n_rows)
+            mat = ds.feature_shards[self._shard_id].tocsr()
+            if mat.shape[0] != e.n_rows or int(mat.nnz) != e.nnz:
+                raise RuntimeError(
+                    f"re-decoded shard {e.index} does not match the "
+                    f"ingested block: got {mat.shape[0]} rows/{mat.nnz} "
+                    f"nnz, cached {e.n_rows}/{e.nnz} — the input "
+                    "changed under the cache")
+            values, cols, rows = padded_csr_arrays(
+                mat, e.rows_bucket, e.nnz_bucket, value_dtype=self.dtype)
+        self._stats["redecodes"] += 1
+        after = getattr(fetch, "payload_bytes_read", None)
+        redecoded = (after - before if before is not None
+                     and after is not None else e.feature_bytes)
+        self._stats["bytes_redecoded"] += redecoded
+        _M_REDECODE_BYTES.inc(redecoded)
+        return restore_spilled_features(
+            SpillBlock(nnz=e.nnz, enc_values=values, enc_cols=cols,
+                       enc_rows=rows, dtype_tag="f32"),
+            e.rows_bucket, self.n_features, e.device)
 
+    def ensure(self, index: int) -> ResidentBlock:
+        """Return a resident snapshot of the block, restoring it on a
+        miss (async put — the caller overlaps it with whatever it is
+        accumulating): buffer spill re-uploads + decodes the host spill
+        record (`restore_spilled_features`), the redecode tier
+        re-decodes the source Avro rows (`_redecode`)."""
         e = self._entries[index]
         if e.feats is None:
-            if e.host_values is None:
-                raise RuntimeError(
-                    f"shard {index} was evicted but has no spill buffers "
-                    "(cache built without an hbm budget)")
             self._stats["misses"] += 1
-            self._stats["bytes_reuploaded"] += e.feature_bytes
             _M_MISSES.inc()
-            _M_REUPLOAD_BYTES.inc(e.feature_bytes)
+            if e.spill is not None:
+                reupload = (e.spill.nbytes if e.spill.dtype_tag != "f32"
+                            else e.feature_bytes)
+            elif self._redecode_fetch is not None:
+                reupload = e.feature_bytes
+            else:
+                raise RuntimeError(
+                    f"shard {index} was evicted but has no spill "
+                    "buffers (cache built without an hbm budget)")
+            self._stats["bytes_reuploaded"] += reupload
+            _M_REUPLOAD_BYTES.inc(reupload)
             self._slot_bytes[e.slot] += e.feature_bytes
             self.peak_device_bytes = max(self.peak_device_bytes,
                                          self.device_bytes)
             _G_PEAK_BYTES.set(self.peak_device_bytes)
-            with span("shard_reupload"):
-                # Spilled blocks return to their ASSIGNED device — the
-                # round-robin placement is part of the replay contract.
-                def idx(x):
-                    return (jnp.asarray(x) if e.device is None
-                            else jax.device_put(x, e.device))
-
-                e.feats = CSRFeatures(
-                    chunked_device_put(e.host_values, device=e.device),
-                    idx(e.host_cols), idx(e.host_rows),
-                    e.rows_bucket, self.n_features)
+            if e.spill is not None:
+                with span("shard_reupload"):
+                    # Spilled blocks return to their ASSIGNED device —
+                    # the round-robin placement is part of the replay
+                    # contract.
+                    e.feats = restore_spilled_features(
+                        e.spill, e.rows_bucket, self.n_features,
+                        e.device)
+            else:
+                e.feats = self._redecode(e)
             self._enforce_budget(pinned=index)
         else:
             self._stats["hits"] += 1
@@ -540,6 +878,11 @@ class DeviceShardCache:
             "hbm_budget_bytes": self.hbm_budget_bytes,
             "device_bytes": self.device_bytes,
             "peak_device_bytes": self.peak_device_bytes,
+            # Host-side spill residency (the O(dataset) cost device
+            # gauges never showed) + the tier that produced it.
+            "spill_dtype": self.spill_dtype,
+            "spill_source": self.spill_source,
+            "spill_bytes_host": self.spill_bytes_host,
             "resident_shards": sum(1 for e in self._entries
                                    if e.feats is not None),
             # Mesh placement: hbm_budget_bytes binds PER device, so the

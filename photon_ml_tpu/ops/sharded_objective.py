@@ -58,9 +58,26 @@ tested:
   same ops);
 - for any fixed shard decomposition, the accumulation is deterministic
   and INDEPENDENT of cache residency AND device count (default
-  combine): resident replay, spill/re-upload replay, prefetch depth and
-  mesh size all produce identical bits (re-uploaded buffers are the
-  evicted bytes; the fold order is the shard order).
+  combine): resident replay, spill/re-upload replay, re-decode replay
+  (``spill_source="redecode"``), prefetch depth and mesh size all
+  produce identical bits (f32-re-uploaded buffers are the evicted
+  bytes, re-decoded blocks reconstruct them exactly; the fold order is
+  the shard order). ``spill_dtype="bf16"`` replays are equally
+  deterministic and residency-independent — values quantize ONCE at
+  ingest, so eviction history cannot touch the bits — but they differ
+  from the f32-spill model by the documented bf16 rounding bound, not
+  by association.
+
+**Restore-dtype contract.** Whatever the cache's spill tier does on
+the host (bf16 values, delta-coded indices, dropped-and-re-decoded
+blocks), every block reaching these kernels must be the f32/i32
+`CSRFeatures` they were compiled for: spill codecs restore THROUGH
+`data/shard_cache.py restore_spilled_features` (the only blessed
+decode path — jaxlint's ``spill-dtype-leak`` rule flags any other
+consumer of the encoded buffers), and this module re-checks the dtype
+at the accumulate boundary (`_require_restored`) so a leaked bf16
+block fails loudly instead of silently retracing every per-bucket
+kernel for a second dtype signature.
 
 Compile discipline: every kernel — one instance PER MESH DEVICE, so each
 device's executables are its own — is built once per objective instance
@@ -83,6 +100,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.telemetry import span
@@ -403,6 +421,22 @@ class ShardedGLMObjective:
 
     # -- accumulation passes ----------------------------------------------
 
+    def _require_restored(self, block) -> None:
+        """The restore-dtype contract's runtime half (module docstring):
+        a feature block must arrive as the dtype the per-bucket kernels
+        compiled for. A bf16/delta-encoded spill buffer leaking past
+        `restore_spilled_features` would otherwise silently jit-trace a
+        SECOND executable per bucket (dtype is part of the signature)
+        and accumulate at the wrong precision."""
+        got = np.dtype(block.feats.values.dtype)
+        want = np.dtype(getattr(self.cache, "dtype", np.float32))
+        if got != want:
+            raise TypeError(
+                f"feature block {block.index} reached the sharded "
+                f"accumulate as {got}, kernels were compiled for {want} "
+                "— spill codecs must restore through "
+                "data/shard_cache.py restore_spilled_features")
+
     def _finish_grad(self, g_raw: Array, su: Array, coef: Array,
                      l2) -> Array:
         """Apply the normalization chain + L2 ONCE at the apex (same
@@ -431,6 +465,7 @@ class ShardedGLMObjective:
         with span("accumulate"):
             coefs = self._per_device(coef)
             for e in self.cache.blocks():
+                self._require_restored(e)
                 with self._dev_span(e.slot):
                     z, val, g_raw, su = self._kits[e.slot]["init"](
                         e.feats, e.labels, e.offsets, e.weights,
@@ -451,6 +486,7 @@ class ShardedGLMObjective:
         with span("accumulate"):
             dirs = self._per_device(direction)
             for e in self.cache.blocks():
+                self._require_restored(e)
                 with self._dev_span(e.slot):
                     out.append(self._kits[e.slot]["dir"](
                         e.feats, e.labels, e.offsets, e.weights,
@@ -489,6 +525,7 @@ class ShardedGLMObjective:
         fold = self._new_fold()
         with span("accumulate"):
             for e, z in zip(self.cache.blocks(), z_list):
+                self._require_restored(e)
                 with self._dev_span(e.slot):
                     part = self._kits[e.slot]["grad"](
                         e.feats, e.labels, e.weights, z, n=e.n_rows)
@@ -511,6 +548,7 @@ class ShardedGLMObjective:
         with span("accumulate"):
             vecs = self._per_device(vec)
             for e, d2 in zip(self.cache.blocks(), d2_list):
+                self._require_restored(e)
                 with self._dev_span(e.slot):
                     part = self._kits[e.slot]["hvp"](
                         e.feats, e.labels, e.offsets, e.weights, d2,

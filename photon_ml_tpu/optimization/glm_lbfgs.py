@@ -304,6 +304,18 @@ def minimize_lbfgs_glm_streaming(
     [d]-space outer iteration here — direction, history, convergence —
     runs on the fold device. With the default "ordered" combine the
     solve result is bit-identical for every device count.
+
+    Spill-tier interaction: the margin cache (z per shard) and the
+    line-search trials live in ROW space, which the cache never evicts
+    — so `trial_values` and `update_margins` walk `cache.entries`
+    without touching feature residency, and the compressed
+    (``spill_dtype="bf16"``) and fully out-of-core
+    (``spill_source="redecode"``) tiers change NOTHING about the
+    iteration structure: the whole Armijo sweep still costs zero
+    feature passes, zero re-uploads and zero Avro re-decodes; only the
+    2 feature passes per iteration (direction matvec, accepted
+    gradient) pay the miss path, so a redecode epoch re-decodes each
+    evicted block at most twice per outer iteration.
     """
     import numpy as np
 

@@ -371,7 +371,16 @@ def minimize_tron_streaming(
     the objective's mesh) device-count-independent: per-shard curvature
     stays resident on each shard's mesh device, each CG step broadcasts
     the direction and folds the Hvp partials in fixed shard order, while
-    the [d]-space trust-region algebra here runs on the fold device."""
+    the [d]-space trust-region algebra here runs on the fold device.
+
+    Spill-tier interaction: margins and curvature (the per-outer-
+    iteration row-space state) are never evicted, so the compressed
+    (``spill_dtype="bf16"``) and out-of-core (``spill_source=
+    "redecode"``) tiers only affect the FEATURE passes — each CG Hvp
+    walks `cache.blocks()` and pays the miss path (re-upload + decode,
+    or Avro re-decode) per evicted block, so an outer iteration with k
+    CG steps costs (k + 2) restore epochs; the trust-region
+    accept/reject arithmetic itself touches no features at all."""
     import numpy as np
 
     sobj = sharded_objective

@@ -223,6 +223,84 @@ def test_multi_file_stream_preserves_order(tmp_path, rng):
 
 
 @pytest.mark.native_decoder
+def test_random_access_fetch_matches_streamed_batches(stream_file,
+                                                      shard_maps):
+    """BlockRandomAccess.fetch_rows reproduces EVERY streamed batch byte
+    for byte (batch_rows=37 cuts through every ~85-record block, so
+    each fetch must skip a partial head block and stop mid-block)."""
+    from photon_ml_tpu.data.block_stream import BlockRandomAccess
+
+    stream = BlockGameStream(stream_file, ["userId", "itemId"], shard_maps,
+                             batch_rows=37, feeder="native")
+    ra = BlockRandomAccess(stream_file, ["userId", "itemId"], shard_maps,
+                           feeder="native")
+    assert ra.decode_path == "native"
+    assert ra.total_rows == 1000
+    row = 0
+    for batch in stream:
+        got = ra.fetch_rows(row, batch.num_rows)
+        _assert_batches_identical(got, batch)
+        row += batch.num_rows
+    assert ra.rows_fetched == 1000
+    assert ra.payload_bytes_read > 0
+    assert ra.blocks_decoded > 0
+
+
+def test_random_access_python_feeder_matches_stream(stream_file,
+                                                    shard_maps):
+    """The python datum-decode path of fetch_rows is byte-identical to
+    the python record-loop stream — the redecode tier works with or
+    without the C extension."""
+    from photon_ml_tpu.data.block_stream import BlockRandomAccess
+
+    stream = BlockGameStream(stream_file, ["userId"], shard_maps,
+                             batch_rows=64, feeder="python")
+    ra = BlockRandomAccess(stream_file, ["userId"], shard_maps,
+                           feeder="python")
+    assert ra.decode_path == "python"
+    batches = list(stream)
+    # spot-check a head, middle and tail batch (python decode is slow)
+    for k in (0, len(batches) // 2, len(batches) - 1):
+        got = ra.fetch_rows(64 * k, batches[k].num_rows)
+        _assert_batches_identical(got, batches[k])
+
+
+@pytest.mark.native_decoder
+def test_random_access_spans_file_boundary(tmp_path, rng):
+    from photon_ml_tpu.data.avro_reader import build_index_map
+    from photon_ml_tpu.data.block_stream import BlockRandomAccess
+
+    p1, p2 = tmp_path / "a.avro", tmp_path / "b.avro"
+    _write_stream_file(p1, 300, rng)
+    _write_stream_file(p2, 170, rng)
+    maps = {"global": build_index_map([p1, p2], ingest_workers=1)}
+    batches = list(BlockGameStream([p1, p2], ["userId"], maps,
+                                   batch_rows=90, feeder="native"))
+    ra = BlockRandomAccess([p1, p2], ["userId"], maps, feeder="native")
+    assert ra.total_rows == 470
+    # batch index 3 covers rows [270, 360): spans the 300-row boundary
+    got = ra.fetch_rows(270, 90)
+    _assert_batches_identical(got, batches[3])
+
+
+def test_random_access_validates_ranges_and_feeder(stream_file,
+                                                   shard_maps,
+                                                   monkeypatch):
+    from photon_ml_tpu.data.block_stream import BlockRandomAccess
+
+    ra = BlockRandomAccess(stream_file, [], shard_maps, feeder="python")
+    with pytest.raises(ValueError, match="n_rows"):
+        ra.fetch_rows(0, 0)
+    with pytest.raises(ValueError, match="outside"):
+        ra.fetch_rows(990, 20)
+    with pytest.raises(ValueError, match="feeder"):
+        BlockRandomAccess(stream_file, [], shard_maps, feeder="turbo")
+    _force_no_native(monkeypatch)
+    with pytest.raises(RuntimeError, match="native"):
+        BlockRandomAccess(stream_file, [], shard_maps, feeder="native")
+
+
+@pytest.mark.native_decoder
 def test_single_partial_batch_when_batch_rows_exceeds_input(stream_file,
                                                             shard_maps):
     batches = list(BlockGameStream(stream_file, ["userId"], shard_maps,

@@ -647,6 +647,102 @@ def test_stream_train_spill_identical_across_residency(tmp_path, rng):
     assert one["numRows"] == big["numRows"] == 300
 
 
+def test_stream_train_spill_source_redecode_model_identity(tmp_path, rng):
+    """Fully out-of-core epochs: --spill-source redecode (evicted blocks
+    dropped, misses re-decode Avro) writes model bytes IDENTICAL to the
+    buffer-spill run — for the native and the python feeder — because a
+    re-decoded block reconstructs the evicted padded triplet exactly.
+    The explicit --spill-dtype f32 spelling equals the default."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64", "--hbm-budget", "8K"]
+    buffer_run = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "buf"),
+                "--spill-dtype", "f32"])
+    assert buffer_run["stream_train"]["cache"]["evictions"] > 0
+    assert buffer_run["stream_train"]["cache"]["spill_bytes_host"] > 0
+    ref = _coeff_records(tmp_path / "buf")
+    for tag, extra in (("rd", []), ("rd_py", ["--feeder", "python"])):
+        out = tmp_path / tag
+        summary = game_training_driver.run(
+            base + ["--output-dir", str(out),
+                    "--spill-source", "redecode"] + extra)
+        assert _coeff_records(out) == ref, tag
+        info = summary["stream_train"]
+        assert info["spill_source"] == "redecode"
+        cache = info["cache"]
+        assert cache["spill_bytes_host"] == 0  # no host copy at all
+        assert cache["redecodes"] == cache["misses"] > 0
+        assert cache["bytes_redecoded"] > 0
+        assert info["redecode"]["payload_bytes_read"] > 0
+        assert info["redecode"]["rows_fetched"] > 0
+
+
+def test_stream_train_bf16_spill_parity_and_residency_independence(
+        tmp_path, rng):
+    """Compressed spill: --spill-dtype bf16 (1) is residency-independent
+    — two budgets with very different eviction pressure write IDENTICAL
+    model bytes (values quantize once at ingest) — (2) matches the
+    f32-spill model per-coefficient within the bf16 parity bound, (3)
+    retains 1/3 of the f32 host spill bytes and ~1/3 of its per-epoch
+    re-upload traffic."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64"]
+    f32 = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "f32"),
+                "--hbm-budget", "8K"])
+    small = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "bf_small"),
+                "--hbm-budget", "8K", "--spill-dtype", "bf16"])
+    big = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "bf_big"),
+                "--hbm-budget", "64K", "--spill-dtype", "bf16"])
+    assert small["stream_train"]["cache"]["evictions"] \
+        > big["stream_train"]["cache"]["evictions"]
+    assert _coeff_records(tmp_path / "bf_small") == \
+        _coeff_records(tmp_path / "bf_big")
+    # parity bound vs the f32-spill model: per-coefficient rel error
+    ref = {r["name"]: r["value"]
+           for r in _coeff_records(tmp_path / "f32")[0]["means"]}
+    got = {r["name"]: r["value"]
+           for r in _coeff_records(tmp_path / "bf_small")[0]["means"]}
+    assert set(ref) == set(got)
+    np.testing.assert_allclose([got[k] for k in sorted(ref)],
+                               [ref[k] for k in sorted(ref)],
+                               rtol=0.1, atol=5e-3)
+    c_f32 = f32["stream_train"]["cache"]
+    c_bf = small["stream_train"]["cache"]
+    assert c_bf["spill_bytes_host"] * 3 == c_f32["spill_bytes_host"]
+    assert c_bf["spill_dtype"] == "bf16"
+    # same eviction pressure, compact re-uploads: ~1/3 the f32 traffic
+    # (not exactly — iteration counts may differ at bf16 precision)
+    assert c_bf["bytes_reuploaded"] < 0.5 * c_f32["bytes_reuploaded"]
+
+
+def test_spill_flags_require_hbm_budget(tmp_path, rng):
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=60)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+        "--stream-train", "--batch-rows", "32"]
+    with pytest.raises(ValueError, match="--spill-dtype"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "a"),
+                    "--spill-dtype", "bf16"])
+    with pytest.raises(ValueError, match="--spill-source"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "b"),
+                    "--spill-source", "redecode"])
+    # bf16 compresses buffers; redecode keeps none — reject the combo
+    with pytest.raises(ValueError, match="pick one"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "c"),
+                    "--hbm-budget", "8K", "--spill-dtype", "bf16",
+                    "--spill-source", "redecode"])
+
+
 def test_stream_train_mesh_model_identical_across_mesh_sizes(tmp_path,
                                                              rng):
     """Tentpole acceptance: --mesh-devices 1 writes the PR-5
@@ -711,12 +807,14 @@ def _assert_stream_train_telemetry(out_dir, summary, feeder):
     info = summary["stream_train"]
     assert info["feeder"]["decode_path"] == feeder
     for key in ("mode", "batch_rows", "hbm_budget_bytes", "mesh_devices",
-                "feeder", "cache"):
+                "spill_dtype", "spill_source", "feeder", "cache"):
         assert key in info, key
     if info["cache"] is not None:
         for key in ("hits", "misses", "evictions", "bytes_reuploaded",
                     "peak_device_bytes", "bucket_shapes", "mesh_devices",
-                    "per_device_bytes"):
+                    "per_device_bytes", "spill_dtype", "spill_source",
+                    "spill_bytes_host", "spill_bytes_written",
+                    "redecodes", "bytes_redecoded"):
             assert key in info["cache"], key
         assert "trace_budgets" in info and "trace_counts" in info
         for name, count in info["trace_counts"].items():
@@ -885,11 +983,14 @@ def test_stream_train_snake_schema_and_trace(tmp_path, rng):
 
     info = summary["stream_train"]
     assert set(info) == {"mode", "batch_rows", "hbm_budget_bytes",
-                         "mesh_devices", "feeder", "cache",
+                         "mesh_devices", "spill_dtype", "spill_source",
+                         "feeder", "cache",
                          "trace_budgets", "trace_counts"}
     assert info["batch_rows"] == 32
     assert info["mode"] == "spill"
     assert info["mesh_devices"] is None
+    assert info["spill_dtype"] == "f32"
+    assert info["spill_source"] == "buffer"
     assert "streamTrain" not in summary  # deprecated alias removed
 
     tele = summary["telemetry"]
@@ -904,6 +1005,11 @@ def test_stream_train_snake_schema_and_trace(tmp_path, rng):
     it_hist = m["histograms"]["training.iteration_seconds"]
     assert it_hist["count"] >= 1 and it_hist["p50"] is not None
     assert m["counters"]["data.shard_cache.evictions"] > 0
+    # the satellite gauge: host spill bytes visible in the registry,
+    # equal to the cache's own accounting
+    assert m["gauges"]["data.shard_cache.spill_bytes_host"] == \
+        info["cache"]["spill_bytes_host"] > 0
+    assert m["counters"]["data.shard_cache.spill_bytes_written"] > 0
 
     doc = json.loads(trace_path.read_text())
     xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]

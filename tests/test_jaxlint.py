@@ -479,7 +479,7 @@ def test_every_rule_has_an_id_and_doc():
     assert sorted(RULE_IDS) == sorted({
         "retrace-hazard", "host-sync", "dtype-drift",
         "nondeterministic-pytree", "telemetry-in-trace",
-        "blocking-in-async"})
+        "spill-dtype-leak", "blocking-in-async"})
     for rule in ALL_RULES:
         assert rule.doc and rule.id
 
@@ -563,6 +563,76 @@ def f(x):
     return x + span(1)
 '''})
     assert vs == []
+
+
+# -- spill-dtype-leak ------------------------------------------------------
+
+def test_spill_dtype_leak_flags_encoded_buffers_outside_restore():
+    """True positives: spill-ENCODED buffers (bf16 values, delta-coded
+    indices) consumed anywhere but the shard cache's blessed restore
+    path — here leaking straight into a device-kernel feature build."""
+    vs = analyze_sources({"photon_ml_tpu/ops/bad.py": '''
+import jax.numpy as jnp
+
+
+def accumulate(e, n_features):
+    values = jnp.asarray(e.spill.enc_values)
+    cols = jnp.asarray(e.spill.enc_cols)
+    return values, cols
+''',
+        "photon_ml_tpu/data/other.py": '''
+
+def peek(spill):
+    return spill.enc_rows[:4]
+'''})
+    assert rules_of(vs) == ["spill-dtype-leak"] * 3
+    assert "restore_spilled_features" in vs[0].message
+
+
+def test_spill_dtype_leak_allows_codec_and_foreign_paths():
+    """False positives: the codec pair + SpillBlock.nbytes in
+    data/shard_cache.py are the blessed consumers; code outside
+    photon_ml_tpu/ (tests, bench) pokes the fields legitimately;
+    non-encoded attributes never trip the rule."""
+    vs = analyze_sources({"photon_ml_tpu/data/shard_cache.py": '''
+import numpy as np
+
+
+class SpillBlock:
+    @property
+    def nbytes(self):
+        return self.enc_values.nbytes + self.enc_cols.nbytes
+
+
+def encode_spill(values, nnz):
+    out = SpillBlock()
+    return out.enc_values
+
+
+def restore_spilled_features(spill):
+    return np.asarray(spill.enc_values), np.asarray(spill.enc_rows)
+
+
+def other_fn(spill):
+    return spill.dtype_tag  # not an encoded buffer
+''',
+        "tests/test_codec.py": '''
+
+def test_roundtrip(blk):
+    assert blk.enc_values.dtype.itemsize == 2
+'''})
+    assert vs == []
+
+
+def test_spill_dtype_leak_flags_leak_even_inside_shard_cache():
+    """A NON-blessed function inside shard_cache itself must still be
+    flagged (the allowance is function-scoped, not module-wide)."""
+    vs = analyze_sources({"photon_ml_tpu/data/shard_cache.py": '''
+
+def ensure(e):
+    return e.spill.enc_values  # bypasses restore_spilled_features
+'''})
+    assert rules_of(vs) == ["spill-dtype-leak"]
 
 
 # -- blocking-in-async -----------------------------------------------------

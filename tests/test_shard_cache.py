@@ -14,7 +14,10 @@ from photon_ml_tpu.data.game_data import GameDataset
 from photon_ml_tpu.data.shard_cache import (
     DeviceShardCache,
     assemble_fixed_effect_batch,
+    encode_spill,
+    restore_spilled_features,
 )
+from photon_ml_tpu.ops.features import padded_csr_arrays
 
 
 class FakeStream:
@@ -117,7 +120,7 @@ def test_cache_padding_and_residency(problem):
         assert e.rows_bucket & (e.rows_bucket - 1) == 0  # pow2
         assert e.nnz_bucket >= e.nnz
         assert e.feats is not None  # unbounded -> fully resident
-        assert e.host_values is None  # spill buffers freed
+        assert e.spill is None  # spill records freed
         # padded row columns carry weight 0 beyond the true rows
         wts = np.asarray(e.weights)
         assert (wts[e.n_rows:] == 0).all()
@@ -233,8 +236,11 @@ def test_cache_stats_keys(problem):
     for key in ("hits", "misses", "evictions", "bytes_reuploaded",
                 "epochs", "shards", "rows", "bucket_shapes",
                 "hbm_budget_bytes", "device_bytes", "peak_device_bytes",
-                "resident_shards"):
+                "resident_shards", "spill_dtype", "spill_source",
+                "spill_bytes_host", "spill_bytes_written", "redecodes",
+                "bytes_redecoded"):
         assert key in s, key
+    assert s["spill_dtype"] == "f32" and s["spill_source"] == "buffer"
 
 
 def test_empty_stream_raises():
@@ -243,3 +249,244 @@ def test_empty_stream_raises():
         assemble_fixed_effect_batch(FakeStream(X, np.zeros(0), 10), "g")
     with pytest.raises(ValueError, match="no rows"):
         DeviceShardCache.from_stream(FakeStream(X, np.zeros(0), 10), "g")
+
+
+# -- spill codecs ----------------------------------------------------------
+
+
+def _feat_bytes(feats):
+    return tuple(_tobytes(getattr(feats, k))
+                 for k in ("values", "col_ids", "row_ids"))
+
+
+def _padded(X, rows_pad, nnz_pad):
+    X = sp.csr_matrix(X)
+    X.sort_indices()
+    return X, padded_csr_arrays(X, rows_pad, nnz_pad)
+
+
+def test_spill_codec_f32_roundtrip_is_bitwise(rng):
+    """f32 spill is the PR-5 raw triplet: restore re-uploads the evicted
+    bytes verbatim."""
+    X, (vals, cols, rows) = _padded(
+        sp.random(90, 50, density=0.1, random_state=0), 128, 1024)
+    blk = encode_spill(vals, cols, rows, X.nnz, "f32")
+    assert blk.dtype_tag == "f32" and blk.nbytes == 12 * 1024
+    feats = restore_spilled_features(blk, 128, 50, None)
+    assert _feat_bytes(feats) == (vals.tobytes(), cols.tobytes(),
+                                  rows.tobytes())
+
+
+@pytest.mark.parametrize("nnz_pad", ["exact", 2048])
+def test_spill_codec_bf16_roundtrip_indices_bitwise(rng, nnz_pad):
+    """bf16 spill: index streams round-trip BIT-exactly (delta codes are
+    lossless), values round-trip through bfloat16 rounding — including
+    at the bucket boundary (nnz == nnz_bucket, zero padding)."""
+    import ml_dtypes
+
+    X = sp.random(60, 300, density=0.05, random_state=1, format="csr")
+    X.data[:] = rng.normal(0, 1, X.nnz)
+    pad = X.nnz if nnz_pad == "exact" else nnz_pad
+    X, (vals, cols, rows) = _padded(X, 64, pad)
+    blk = encode_spill(vals, cols, rows, X.nnz, "bf16")
+    assert blk.dtype_tag == "bf16"
+    # u8 delta codes at this shape: 1 byte per index stream + 2-byte
+    # values = 4/12 of the f32 spill record.
+    assert blk.enc_cols.dtype == np.uint8
+    assert blk.enc_rows.dtype == np.uint8
+    assert blk.nbytes * 3 == 12 * pad
+    feats = restore_spilled_features(blk, 64, 300, None)
+    got_v, got_c, got_r = _feat_bytes(feats)
+    assert got_c == cols.tobytes()
+    assert got_r == rows.tobytes()
+    want = vals.astype(ml_dtypes.bfloat16).astype(np.float32)
+    assert got_v == want.tobytes()
+
+
+def test_spill_codec_bf16_empty_and_single_entry():
+    """Degenerate blocks: zero nnz (all-empty rows) and one entry."""
+    blk = encode_spill(np.zeros(16, np.float32), np.zeros(16, np.int32),
+                       np.zeros(16, np.int32), 0, "bf16")
+    feats = restore_spilled_features(blk, 8, 10, None)
+    assert not np.asarray(feats.values).any()
+    assert not np.asarray(feats.col_ids).any()
+    one = sp.csr_matrix((np.asarray([2.5]), (np.asarray([3]),
+                                             np.asarray([7]))),
+                        shape=(5, 10))
+    _, (vals, cols, rows) = _padded(one, 8, 16)
+    blk = encode_spill(vals, cols, rows, 1, "bf16")
+    feats = restore_spilled_features(blk, 8, 10, None)
+    assert _feat_bytes(feats)[1:] == (cols.tobytes(), rows.tobytes())
+    assert np.asarray(feats.values)[0] == np.float32(2.5)  # exact in bf16
+
+
+def test_spill_codec_u16_and_i32_overflow_fallback(rng):
+    """Code-width selection: deltas in (255, 65535] pick u16; a delta
+    beyond u16 (huge column jump) falls back to RAW i32 ids — and every
+    width round-trips the index bits exactly."""
+    # within-row jumps of ~10_000 -> u16 codes
+    mid = sp.csr_matrix((np.ones(4), ([0, 0, 1, 1], [5, 10_005, 3, 9_003])),
+                        shape=(2, 20_000))
+    _, (vals, cols, rows) = _padded(mid, 2, 8)
+    blk = encode_spill(vals, cols, rows, 4, "bf16")
+    assert blk.enc_cols.dtype == np.uint16
+    feats = restore_spilled_features(blk, 2, 20_000, None)
+    assert _feat_bytes(feats)[1] == cols.tobytes()
+    # a 200_000-column jump overflows u16 -> raw i32 fallback
+    big = sp.csr_matrix((np.ones(2), ([0, 0], [1, 200_001])),
+                        shape=(1, 300_000))
+    _, (vals, cols, rows) = _padded(big, 2, 8)
+    blk = encode_spill(vals, cols, rows, 2, "bf16")
+    assert blk.enc_cols.dtype == np.int32
+    assert blk.enc_rows.dtype == np.uint8  # streams fall back per-stream
+    feats = restore_spilled_features(blk, 2, 300_000, None)
+    assert _feat_bytes(feats)[1:] == (cols.tobytes(), rows.tobytes())
+
+
+def test_spill_codec_rejects_unknown_dtype(rng):
+    with pytest.raises(ValueError, match="spill_dtype"):
+        encode_spill(np.zeros(4, np.float32), np.zeros(4, np.int32),
+                     np.zeros(4, np.int32), 0, "f16")
+
+
+# -- compressed spill + redecode tiers through the cache -------------------
+
+
+def _block_map(cache, **kw):
+    return {b.index: _feat_bytes(b.feats) for b in cache.blocks(**kw)}
+
+
+def test_cache_bf16_spill_indices_bitwise_and_host_bytes_third(problem):
+    """bf16 buffer spill: EVERY block's index bits equal the resident
+    cache's exactly; values equal the bf16 round-trip for resident and
+    restored blocks alike (quantized once at ingest, so replays are
+    residency-independent); host spill bytes measure 1/3 of the f32
+    spill record (u8 index codes at this shape)."""
+    import ml_dtypes
+
+    X, y, off, w = problem
+    resident = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g")
+    block_bytes = max(e.feature_bytes for e in resident.entries)
+    ref = {e.index: _feat_bytes(e.feats) for e in resident.entries}
+    f32 = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g",
+        hbm_budget_bytes=2 * block_bytes)
+    bf16 = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g",
+        hbm_budget_bytes=2 * block_bytes, spill_dtype="bf16")
+    assert bf16.spill_bytes_host * 3 == f32.spill_bytes_host
+    assert bf16.stats()["spill_bytes_written"] == bf16.spill_bytes_host
+    got = _block_map(bf16)
+    for idx, (rv, rc, rr) in ref.items():
+        gv, gc, gr = got[idx]
+        assert (gc, gr) == (rc, rr), idx
+        want = np.frombuffer(rv, np.float32).astype(
+            ml_dtypes.bfloat16).astype(np.float32)
+        assert gv == want.tobytes(), idx
+    # two full replay epochs produce identical bits (restore from the
+    # same spill records is deterministic)
+    assert _block_map(bf16) == got
+    # re-upload traffic is the COMPACT bytes: exactly 1/3 of the f32
+    # tier's over the identical two-epoch access pattern
+    list(f32.blocks())
+    list(f32.blocks())
+    s_f32, s_bf16 = f32.stats(), bf16.stats()
+    assert s_bf16["misses"] == s_f32["misses"] > 0
+    assert s_bf16["bytes_reuploaded"] * 3 == s_f32["bytes_reuploaded"]
+
+
+def test_cache_redecode_tier_drops_host_copy_and_replays_bitwise(problem):
+    """spill_source='redecode': NO host spill bytes; misses re-fetch the
+    block's source rows and the replay is bit-for-bit the resident
+    cache across multiple epochs."""
+    X, y, off, w = problem
+    resident = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g")
+    block_bytes = max(e.feature_bytes for e in resident.entries)
+    ref = {e.index: _feat_bytes(e.feats) for e in resident.entries}
+
+    def fetch(row_start, n_rows):
+        s = slice(row_start, row_start + n_rows)
+        return GameDataset.build(responses=y[s], feature_shards={"g": X[s]},
+                                 offsets=off[s], weights=w[s])
+
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g",
+        hbm_budget_bytes=2 * block_bytes,
+        spill_source="redecode", redecode_fetch=fetch)
+    assert cache.spill_bytes_host == 0
+    assert all(e.spill is None for e in cache.entries)
+    for _ in range(2):
+        assert _block_map(cache) == ref
+    s = cache.stats()
+    assert s["redecodes"] == s["misses"] > 0
+    assert s["bytes_redecoded"] > 0
+    assert s["spill_source"] == "redecode"
+
+
+def test_cache_redecode_validates_fetch_and_requires_hook(problem):
+    """Constructor contract: redecode + budget needs the fetch hook; a
+    fetch that returns the wrong rows (input changed under the cache)
+    fails loudly."""
+    X, y, off, w = problem
+    with pytest.raises(ValueError, match="redecode_fetch"):
+        DeviceShardCache.from_stream(
+            FakeStream(X, y, 100, off, w), "g", hbm_budget_bytes=1,
+            spill_source="redecode")
+
+    def bad_fetch(row_start, n_rows):
+        return GameDataset.build(responses=y[:n_rows],
+                                 feature_shards={"g": X[:n_rows] * 2.0})
+
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g", hbm_budget_bytes=1,
+        spill_source="redecode", redecode_fetch=bad_fetch)
+    with pytest.raises(RuntimeError, match="changed under the cache"):
+        list(cache.blocks(prefetch_depth=0))
+
+
+def test_cache_rejects_unknown_spill_options(problem):
+    X, y, off, w = problem
+    with pytest.raises(ValueError, match="spill_dtype"):
+        DeviceShardCache.from_stream(FakeStream(X, y, 100, off, w), "g",
+                                     spill_dtype="f64")
+    with pytest.raises(ValueError, match="spill_source"):
+        DeviceShardCache.from_stream(FakeStream(X, y, 100, off, w), "g",
+                                     spill_source="disk")
+    # bf16 + redecode would silently train as f32 while reporting bf16
+    # (redecode keeps no buffers to compress) — mutually exclusive.
+    with pytest.raises(ValueError, match="pick one"):
+        DeviceShardCache.from_stream(FakeStream(X, y, 100, off, w), "g",
+                                     hbm_budget_bytes=1,
+                                     spill_dtype="bf16",
+                                     spill_source="redecode",
+                                     redecode_fetch=lambda s, n: None)
+
+
+def test_cache_spill_bytes_host_accounting(problem):
+    """The satellite gauge's source of truth: unbounded caches retain no
+    host spill bytes; f32 buffer spill retains 12 bytes/padded-nnz per
+    shard; the registry twin mirrors it."""
+    from photon_ml_tpu import telemetry
+
+    X, y, off, w = problem
+    unbounded = DeviceShardCache.from_stream(
+        FakeStream(X, y, 100, off, w), "g")
+    assert unbounded.spill_bytes_host == 0
+    assert unbounded.stats()["spill_bytes_host"] == 0
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        cache = DeviceShardCache.from_stream(
+            FakeStream(X, y, 100, off, w), "g", hbm_budget_bytes=1)
+        want = sum(12 * e.nnz_bucket for e in cache.entries)
+        assert cache.spill_bytes_host == want
+        assert cache.stats()["spill_bytes_host"] == want
+        snap = telemetry.snapshot()
+        assert snap["gauges"]["data.shard_cache.spill_bytes_host"] == want
+        assert snap["counters"][
+            "data.shard_cache.spill_bytes_written"] == want
+    finally:
+        telemetry.disable()
+        telemetry.reset()

@@ -303,3 +303,58 @@ def test_streaming_coordinate_scope_errors(problem):
     model, result = coord("10,1e-6,1.0,1.0,LBFGS,L2").solve()
     assert model.glm.coefficients.means.shape == (X.shape[1],)
     assert int(result.iterations) > 0
+
+
+def test_redecode_replay_bitwise_matches_resident(problem, rng):
+    """The fully out-of-core tier: a redecode cache (evicted blocks
+    dropped, misses re-fetched) produces (value, gradient) bitwise
+    equal to the fully resident fold — the re-decoded padded triplet
+    IS the ingested one."""
+    X, y, off, w = problem
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    resident = _sharded(X, y, off, w, batch_rows=200, obj=obj)
+    block = max(e.feature_bytes for e in resident.cache.entries)
+
+    from photon_ml_tpu.data.game_data import GameDataset
+
+    def fetch(row_start, n_rows):
+        s = slice(row_start, row_start + n_rows)
+        Xc = sp.csr_matrix(X)
+        return GameDataset.build(responses=y[s],
+                                 feature_shards={"g": Xc[s]},
+                                 offsets=off[s], weights=w[s])
+
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 200, off, w), "g", hbm_budget_bytes=block,
+        spill_source="redecode", redecode_fetch=fetch)
+    sobj = ShardedGLMObjective(obj, cache)
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    l2 = jnp.asarray(0.7, jnp.float32)
+    f_res, g_res = resident.value_and_grad(coef, l2)
+    for _ in range(2):  # two epochs: steady-state misses too
+        f, g = sobj.value_and_grad(coef, l2)
+        assert _bits(f) == _bits(f_res)
+        assert _bits(g) == _bits(g_res)
+    assert cache.stats()["redecodes"] > 0
+    assert cache.spill_bytes_host == 0
+
+
+def test_restore_dtype_contract_rejects_leaked_bf16(problem, rng):
+    """The runtime half of the restore-dtype contract: a feature block
+    that reaches the accumulate as bf16 (i.e. a spill buffer leaked
+    past restore_spilled_features) fails loudly instead of silently
+    tracing second executables per bucket."""
+    import dataclasses as dc
+
+    from photon_ml_tpu.ops.features import CSRFeatures
+
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w, batch_rows=200)
+    e = sobj.cache.entries[0]
+    leaked = CSRFeatures(e.feats.values.astype(jnp.bfloat16),
+                         e.feats.col_ids, e.feats.row_ids,
+                         e.rows_bucket, sobj.cache.n_features)
+    sobj.cache._entries[0] = dc.replace(e, feats=leaked)
+    coef = jnp.zeros((X.shape[1],), jnp.float32)
+    with pytest.raises(TypeError, match="restore_spilled_features"):
+        sobj.value_and_grad(coef, jnp.asarray(0.1, jnp.float32))
