@@ -30,6 +30,15 @@ Scope and mechanics:
   literal fragments checked for illegal characters (uppercase or
   anything outside ``[a-z0-9_.]``); the dynamic parts are runtime
   values the lint cannot see.
+- An EXEMPLAR-BEARING histogram (``histogram(..., exemplars=True)`` —
+  its buckets carry trace_id exemplars rendered on /metrics,
+  docs/OBSERVABILITY.md §Exemplars) must name a latency distribution:
+  the literal name must end in ``_seconds`` (exemplars link latency
+  buckets to /tracez timelines; a counter-shaped or unitless histogram
+  carrying exemplars is a schema smell), and one name must not be
+  declared exemplar-bearing at one site and plain at another (the
+  registry is get-or-create — whichever call runs first would silently
+  win).
 
 Exit 0 = clean. Run via tests.sh or directly:
     python dev_scripts/metric_names.py [--root DIR] [paths...]
@@ -85,10 +94,21 @@ def _literal_parts(node):
     return [], False
 
 
+def _exemplars_kwarg(node: ast.Call):
+    """True/False when the call passes a literal ``exemplars=`` keyword,
+    None when absent or non-literal."""
+    for kw in node.keywords:
+        if kw.arg == "exemplars" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return None
+
+
 def check_file(path: Path, src: str, registrations: dict) -> list:
     """Violations in one file; literal registrations accumulate into
-    ``registrations`` (name -> {kind: first location}) for the
-    cross-file conflicting-type check."""
+    ``registrations`` (name -> {kind: first location}, with histogram
+    kinds split into ``histogram``/``histogram_exemplars`` so an
+    exemplar-bearing and a plain declaration of one name conflict) for
+    the cross-file conflicting-type check."""
     try:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:
@@ -106,6 +126,8 @@ def check_file(path: Path, src: str, registrations: dict) -> list:
             kind = fn.id
         else:
             continue
+        exemplars = (_exemplars_kwarg(node) if kind == "histogram"
+                     else None)
         frags, full = _literal_parts(node.args[0])
         if not frags:
             continue  # fully dynamic: runtime's problem
@@ -117,8 +139,22 @@ def check_file(path: Path, src: str, registrations: dict) -> list:
                             "snake_case — segment(.segment)*, each "
                             "[a-z][a-z0-9_]* (docs/OBSERVABILITY.md)"))
             else:
+                if exemplars and not name.endswith("_seconds"):
+                    out.append((
+                        path, node.lineno, "exemplar-histogram-name",
+                        f"histogram({name!r}, exemplars=True): exemplar-"
+                        "bearing histograms carry trace_id latency "
+                        "exemplars and must end in '_seconds' "
+                        "(docs/OBSERVABILITY.md §Exemplars)"))
                 prev = registrations.setdefault(name, {})
                 prev.setdefault(kind, (path, node.lineno))
+                if exemplars is not None:
+                    # Marker entries (filtered out of the type check):
+                    # an explicit exemplars=True at one site and
+                    # exemplars=False at another disagree about one
+                    # get-or-create name; kwarg-less reads stay exempt.
+                    prev.setdefault(f"exemplars_{exemplars}".lower(),
+                                    (path, node.lineno))
         else:
             for frag in frags:
                 m = _FRAGMENT_BAD_RE.search(frag)
@@ -132,16 +168,31 @@ def check_file(path: Path, src: str, registrations: dict) -> list:
     return out
 
 
+_MARKER_KINDS = ("exemplars_true", "exemplars_false")
+
+
 def conflicting_types(registrations: dict) -> list:
     out = []
     for name, kinds in sorted(registrations.items()):
-        if len(kinds) > 1:
+        real = {k: v for k, v in kinds.items()
+                if k not in _MARKER_KINDS}
+        if len(real) > 1:
             where = ", ".join(
                 f"{kind} at {p}:{ln}"
-                for kind, (p, ln) in sorted(kinds.items()))
+                for kind, (p, ln) in sorted(real.items()))
             out.append((Path("-"), 0, "metric-type-conflict",
                         f"{name!r} registered as multiple metric types: "
                         f"{where}"))
+        if all(m in kinds for m in _MARKER_KINDS):
+            where = ", ".join(
+                f"exemplars={m.rsplit('_', 1)[1]} at {p}:{ln}"
+                for m, (p, ln) in sorted(kinds.items())
+                if m in _MARKER_KINDS)
+            out.append((Path("-"), 0, "exemplar-declaration-conflict",
+                        f"{name!r} declared both exemplar-bearing and "
+                        f"plain ({where}) — the registry is "
+                        "get-or-create, whichever runs first wins "
+                        "silently"))
     return out
 
 

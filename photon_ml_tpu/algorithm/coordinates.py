@@ -373,10 +373,13 @@ class StreamingFixedEffectCoordinate:
             glm_cls(Coefficients.zeros(self.cache.n_features, self.dtype)),
             self.feature_shard_id)
 
-    def solve(self, model: Optional[FixedEffectModel] = None
-              ) -> Tuple[FixedEffectModel, OptimizerResult]:
+    def solve(self, model: Optional[FixedEffectModel] = None,
+              trace_ctx=None) -> Tuple[FixedEffectModel, OptimizerResult]:
         """One full-batch GLM solve by streamed accumulation (warm-started
-        from ``model`` when given)."""
+        from ``model`` when given). ``trace_ctx`` — the solve's trace
+        context (telemetry/tracectx.py; the streaming driver mints one
+        per λ-grid point), threaded into the host-driven solver for
+        per-iteration events and divergence-watchdog tagging."""
         from photon_ml_tpu.optimization.config import OptimizerType
         from photon_ml_tpu.optimization.glm_lbfgs import (
             minimize_lbfgs_glm_streaming,
@@ -394,12 +397,12 @@ class StreamingFixedEffectCoordinate:
             result = minimize_tron_streaming(
                 self._sharded, coef0, self._l2,
                 max_iter=self.config.max_iterations,
-                tol=self.config.tolerance)
+                tol=self.config.tolerance, trace_ctx=trace_ctx)
         else:
             result = minimize_lbfgs_glm_streaming(
                 self._sharded, coef0, self._l2,
                 max_iter=self.config.max_iterations,
-                tol=self.config.tolerance)
+                tol=self.config.tolerance, trace_ctx=trace_ctx)
         self._sharded.assert_trace_budget()
         from photon_ml_tpu.models.coefficients import Coefficients
 

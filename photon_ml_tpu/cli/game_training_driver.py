@@ -117,7 +117,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="start-end in days ago, e.g. 90-1")
     p.add_argument("--validate-date-range", default=None)
     p.add_argument("--validate-date-range-days-ago", default=None)
-    p.add_argument("--num-iterations", type=int, default=1)
+    # >= 1 enforced: the stream-train λ-grid loop reads the last
+    # tracker after its solves, and 0 iterations never made a model on
+    # any path anyway.
+    p.add_argument("--num-iterations", type=_positive_int, default=1)
     p.add_argument("--checkpoint-dir", default=None,
                    help="resumable coordinate-descent checkpoints land "
                         "here; a rerun resumes from the latest")
@@ -264,7 +267,7 @@ def run(argv=None) -> dict:
         with span("driver"):
             (sequence, results, best_configs, best_result, shard_maps,
              num_rows, stream_info) = _run_training(
-                args, logger, task, emitter)
+                args, logger, task, emitter, obs)
             _save_outputs(args, out_dir, logger, sequence, results,
                           best_configs, best_result, shard_maps)
         summary = _write_summary(args, out_dir, logger, task, sequence,
@@ -289,9 +292,11 @@ def run(argv=None) -> dict:
         telemetry.disable()
 
 
-def _run_training(args, logger, task, emitter):
+def _run_training(args, logger, task, emitter, obs):
     """Config parse + train (one-shot estimator or --stream-train);
-    returns everything the save/summary tail needs."""
+    returns everything the save/summary tail needs. ``obs`` (the
+    driver's observability plane) lets the stream-train path register
+    live /statusz providers as its components come up."""
     fe_data = _parse_named(args.fixed_effect_data_configurations,
                            "fixed-effect data config")
     fe_opt = _parse_named(args.fixed_effect_optimization_configurations,
@@ -391,7 +396,7 @@ def _run_training(args, logger, task, emitter):
              stream_info) = _stream_train(
                 args, logger, task, fe_data, fe_opt, sequence,
                 train_inputs, evaluators, preloaded_maps, opt_grid,
-                emitter)
+                emitter, obs)
         return (sequence, results, best_configs, best_result, shard_maps,
                 num_rows, stream_info)
 
@@ -592,7 +597,7 @@ def _stream_validate_many(game_models, args, shard_maps, evaluators,
 
 def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                   train_inputs, evaluators, preloaded_maps, opt_grid,
-                  emitter):
+                  emitter, obs):
     """Out-of-core training path (--stream-train): block-streamed ingest
     (host memory O(batch_rows)) into either
 
@@ -714,6 +719,10 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                 prefetch_depth=max(0, args.prefetch_batches),
                 devices=devices, spill_dtype=args.spill_dtype,
                 spill_source=args.spill_source, redecode_fetch=fetcher)
+        # Live residency view: a multi-hour spill train's /statusz
+        # shows hits/misses/evictions/spill bytes as they happen —
+        # mirroring what --serve registers for frontend stats.
+        obs.add_status_provider("shard_cache", cache.stats)
         results = []
         shared = None
         with span("solve"):
@@ -725,10 +734,22 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                 shared = coord.sharded_objective
                 t0 = _time.perf_counter()
                 model, trackers, obj_hist = None, [], []
+                # One trace context per λ-grid point: the solve's
+                # identity across its outer iterations — slow solves
+                # land in the /tracez tail, and a divergence fault
+                # carries this trace_id into the flight dump.
+                ctx = telemetry.mint("solve")
+                ctx.annotate(coordinate=name,
+                             reg_weight=cfg.regularization_weight,
+                             optimizer=str(cfg.optimizer_type))
                 for _ in range(args.num_iterations):
-                    model, res = coord.solve(model)
+                    model, res = coord.solve(model, trace_ctx=ctx)
                     trackers.append(res)
                     obj_hist.append(float(res.value))
+                ctx.annotate(
+                    iterations=int(trackers[-1].iterations),
+                    reason=trackers[-1].reason_enum().summary)
+                ctx.finish("ok")
                 gm = GameModel({name: model}, task)
                 results.append(({name: cfg}, CoordinateDescentResult(
                     model=gm, objective_history=obj_hist,

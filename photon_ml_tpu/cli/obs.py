@@ -38,6 +38,7 @@ from photon_ml_tpu.telemetry import (
     ObservabilityServer,
     SLOTracker,
     install_sigterm_dump,
+    trace_tail,
 )
 
 
@@ -46,11 +47,14 @@ def add_observability_args(p) -> None:
     p.add_argument("--obs-port", type=int, default=None, metavar="PORT",
                    help="serve the live observability plane on "
                         "127.0.0.1:PORT for the duration of the run: "
-                        "/metrics (Prometheus text), /healthz, /statusz "
-                        "(registry + stage attribution + per-model "
-                        "serving stats + SLO), /debugz/dump (flight "
-                        "recorder). 0 binds an ephemeral port, written "
-                        "to <output-dir>/obs_port and reported in "
+                        "/metrics (Prometheus text; exemplars on "
+                        "OpenMetrics-negotiated scrapes), /healthz, "
+                        "/statusz (registry + stage attribution + "
+                        "per-model serving stats + profiler table + "
+                        "SLO), /tracez (tail-sampled request/solve "
+                        "timelines), /debugz/dump (flight recorder). "
+                        "0 binds an ephemeral port, written to "
+                        "<output-dir>/obs_port and reported in "
                         "metrics.json (docs/OBSERVABILITY.md)")
     p.add_argument("--flight-events", type=int, default=4096, metavar="N",
                    help="flight-recorder ring size: the last N completed "
@@ -134,13 +138,17 @@ class DriverObservability:
         KeyboardInterrupt on a wedged run) dumps. The span context
         managers have already unwound through the failing stage by the
         time the driver's except block runs, so the ring's last events
-        cover it."""
+        cover it. A fault carrying a ``trace_id`` (e.g. the divergence
+        watchdog's SolverDivergedError) tags the dump with it — the
+        dump's ``flight.traces`` block holds that solve's tail-kept
+        timeline."""
         if (self.recorder is None or self._fault_dumped
                 or isinstance(exc, SystemExit)):
             return
         try:
             self.recorder.dump(self.flight_path,
-                               reason=f"fault:{type(exc).__name__}")
+                               reason=f"fault:{type(exc).__name__}",
+                               trace_id=getattr(exc, "trace_id", None))
             self._fault_dumped = True
             if logger is not None:
                 logger.error("flight recorder dumped to %s (%s)",
@@ -163,6 +171,9 @@ class DriverObservability:
                 "flight_path": (str(self.flight_path)
                                 if self.recorder is not None
                                 and self.recorder.dumps > 0 else None),
+                # Tail-sampler counters (full timelines live on /tracez
+                # and in flight dumps — metrics.json keeps the books).
+                "trace_tail": trace_tail().counters(),
             }
         return summary
 

@@ -13,6 +13,7 @@ here mode is just where the arrays live):
 from photon_ml_tpu.optimization.convergence import (
     ConvergenceReason,
     OptimizerResult,
+    SolverDivergedError,
 )
 from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
 from photon_ml_tpu.optimization.newton import minimize_newton
@@ -30,6 +31,7 @@ from photon_ml_tpu.optimization.config import (
 __all__ = [
     "ConvergenceReason",
     "OptimizerResult",
+    "SolverDivergedError",
     "minimize_lbfgs",
     "minimize_newton",
     "minimize_owlqn",

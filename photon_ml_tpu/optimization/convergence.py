@@ -15,11 +15,62 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import math
 from typing import Optional
 
 import jax
 
 Array = jax.Array
+
+
+def check_solver_finite(solver: str, iteration: int, value, grad_norm,
+                        trace_ctx=None) -> None:
+    """Divergence watchdog for the host-driven streaming solvers: raise
+    :class:`SolverDivergedError` when loss or gradient norm went
+    non-finite. ``value``/``grad_norm`` must already be HOST scalars
+    (the streamed outer loops hold them for convergence compares, so
+    the check adds no device sync). ``trace_ctx`` — the solve's trace
+    context, finished as ``diverged`` (tail-kept) and its id attached
+    to the fault so the flight dump is tagged with it."""
+    v, g = float(value), float(grad_norm)
+    if math.isfinite(v) and math.isfinite(g):
+        return
+    trace_id = None
+    if trace_ctx is not None:
+        trace_id = trace_ctx.trace_id
+        trace_ctx.annotate(solver=solver, iteration=int(iteration),
+                           value=v, grad_norm=g)
+        trace_ctx.finish("diverged")
+    raise SolverDivergedError(solver, iteration, v, g, trace_id=trace_id)
+
+
+class SolverDivergedError(RuntimeError):
+    """A host-driven streaming solver observed a non-finite loss or
+    gradient norm — the divergence watchdog's typed fault.
+
+    The fused ``lax.while_loop`` solvers cannot raise mid-solve (a NaN
+    silently rides the history arrays to a convergence-failure reason);
+    the streamed L-BFGS/TRON outer loops run on the HOST, so they check
+    every outer iteration and fail fast with the evidence attached:
+    which solver, which iteration, the offending value/grad-norm, and
+    the solve's trace_id (telemetry/tracectx.py) so the driver's flight
+    dump — which this fault triggers like any other unhandled driver
+    exception — is tagged with a resolvable timeline."""
+
+    def __init__(self, solver: str, iteration: int, value, grad_norm,
+                 trace_id: Optional[str] = None):
+        super().__init__(
+            f"{solver} diverged at outer iteration {iteration}: "
+            f"value={value!r}, grad_norm={grad_norm!r} (non-finite). "
+            "Typical causes: learning-rate/regularization far off scale, "
+            "corrupt feature values, or an overflowing loss; see the "
+            "flight dump for the solve's last stages"
+            + (f" (trace {trace_id})" if trace_id else ""))
+        self.solver = solver
+        self.iteration = int(iteration)
+        self.value = value
+        self.grad_norm = grad_norm
+        self.trace_id = trace_id
 
 
 class ConvergenceReason(enum.IntEnum):

@@ -38,6 +38,7 @@ from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.optimization.convergence import (
     ConvergenceReason,
     OptimizerResult,
+    check_solver_finite,
 )
 from photon_ml_tpu.optimization.lbfgs import (
     _LBFGSHistory,
@@ -282,6 +283,7 @@ def minimize_lbfgs_glm_streaming(
     c1: float = 1e-4,
     max_line_search: int = 30,
     track_coefficients: bool = False,
+    trace_ctx=None,
 ) -> OptimizerResult:
     """Out-of-core L-BFGS: the outer iteration runs on the host, streaming
     each feature pass through a :class:`ShardedGLMObjective`
@@ -316,6 +318,17 @@ def minimize_lbfgs_glm_streaming(
     2 feature passes per iteration (direction matvec, accepted
     gradient) pay the miss path, so a redecode epoch re-decodes each
     evicted block at most twice per outer iteration.
+
+    Divergence watchdog: the host already holds loss and grad-norm as
+    scalars for the convergence compares, so every outer iteration (and
+    the initial evaluation) checks them for NaN/Inf and raises a typed
+    :class:`~photon_ml_tpu.optimization.convergence.SolverDivergedError`
+    — the fused impl cannot do this mid-``while_loop`` and silently
+    rides a NaN to a convergence-failure reason. ``trace_ctx`` (one
+    :class:`~photon_ml_tpu.telemetry.tracectx.TraceContext` per solve,
+    minted per λ-grid point by the streaming driver) gets one
+    ``solver_step`` event per outer iteration and, on divergence, a
+    ``diverged`` finish whose trace_id tags the fault and flight dump.
     """
     import numpy as np
 
@@ -339,6 +352,7 @@ def minimize_lbfgs_glm_streaming(
     z_list, f, g = sobj.margins_value_grad(x, l2)
     f_h = host(f)
     gnorm = host(jnp.linalg.norm(g))
+    check_solver_finite("streaming-lbfgs", 0, f_h, gnorm, trace_ctx)
     gnorm0 = gnorm
     f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
     hist = _empty_history(d, history_size, dtype)
@@ -360,6 +374,8 @@ def minimize_lbfgs_glm_streaming(
         # impl cannot expose from inside its lax.while_loop.
         with telemetry.timed_span("solver_step", histogram=_H_ITERATION,
                                   counter=_M_ITERATIONS):
+            if trace_ctx is not None:
+                trace_ctx.event("solver_step")
             direction, xx, xp, pp, gp = _stream_direction(g, hist, x)
             zp_list = sobj.margin_direction_list(direction)
 
@@ -412,6 +428,10 @@ def minimize_lbfgs_glm_streaming(
 
             gnorm_new = host(jnp.linalg.norm(g_new))
             f_new_h = host(f_new)
+            # Watchdog: both scalars are already host-side for the
+            # convergence compares below — the check adds no sync.
+            check_solver_finite("streaming-lbfgs", it, f_new_h,
+                                gnorm_new, trace_ctx)
             f_delta = np.abs(f_h - f_new_h)
             x, z_list, f, g = x_new, z_new, f_new, g_new
             f_h, gnorm = f_new_h, gnorm_new

@@ -25,6 +25,7 @@ from photon_ml_tpu import telemetry
 from photon_ml_tpu.optimization.convergence import (
     ConvergenceReason,
     OptimizerResult,
+    check_solver_finite,
 )
 from photon_ml_tpu.optimization.lbfgs import _project
 
@@ -359,6 +360,7 @@ def minimize_tron_streaming(
     max_cg: int = 20,
     max_improvement_failures: int = 5,
     track_coefficients: bool = False,
+    trace_ctx=None,
 ) -> OptimizerResult:
     """Out-of-core TRON: the outer trust-region loop runs on the host;
     each value/gradient evaluation and each inner-CG Hessian-vector
@@ -380,7 +382,16 @@ def minimize_tron_streaming(
     walks `cache.blocks()` and pays the miss path (re-upload + decode,
     or Avro re-decode) per evicted block, so an outer iteration with k
     CG steps costs (k + 2) restore epochs; the trust-region
-    accept/reject arithmetic itself touches no features at all."""
+    accept/reject arithmetic itself touches no features at all.
+
+    Divergence watchdog + ``trace_ctx``: same contract as
+    `minimize_lbfgs_glm_streaming` — loss/grad-norm checked for NaN/Inf
+    each outer iteration on already-host scalars (typed
+    ``SolverDivergedError``, trace-tagged), one ``solver_step`` trace
+    event per accepted or rejected outer step. An unaccepted trial with
+    non-finite value is NOT a divergence — the trust region shrinks and
+    retries, exactly like the fused impl — so only the accepted state
+    is checked."""
     import numpy as np
 
     sobj = sharded_objective
@@ -396,6 +407,7 @@ def minimize_tron_streaming(
     z_list, f, g = sobj.margins_value_grad(x, l2)
     f_h = host(f)
     gnorm = host(jnp.linalg.norm(g))
+    check_solver_finite("streaming-tron", 0, f_h, gnorm, trace_ctx)
     gnorm0 = gnorm
     f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
     delta = jnp.asarray(gnorm0, dtype)
@@ -419,6 +431,8 @@ def minimize_tron_streaming(
         # schema as the streaming L-BFGS.
         with telemetry.timed_span("solver_step", histogram=_H_ITERATION,
                                   counter=_M_ITERATIONS):
+            if trace_ctx is not None:
+                trace_ctx.event("solver_step")
             d2_list = sobj.curvature_list(z_list)
 
             # -- truncated CG (streamed Hv per step) ----------------------
@@ -451,6 +465,11 @@ def minimize_tron_streaming(
                 f_delta = np.abs(f_h - f_new_h)
                 f, f_h = f_new, f_new_h
                 gnorm = host(jnp.linalg.norm(g))
+                # Watchdog on the ACCEPTED state (host scalars already
+                # in hand — no added sync); a rejected non-finite trial
+                # is normal trust-region behavior, not divergence.
+                check_solver_finite("streaming-tron", it, f_h, gnorm,
+                                    trace_ctx)
                 value_hist[it], gnorm_hist[it] = f_h, gnorm
                 if coef_hist is not None:
                     coef_hist[it] = np.asarray(x)

@@ -78,12 +78,20 @@ class ExecutableCache:
     compile-count invariants are assertable rather than hand-counted:
     ``assert_max_retraces(max_total=N)`` bounds the executables ever
     built AND their retraces — an evicted-and-rebuilt bucket stays in
-    the guard's totals under a fresh generation name."""
+    the guard's totals under a fresh generation name.
+
+    ``profiler`` (telemetry/profiler.py) accumulates per-key compile
+    economics (lower wall time, ``cost_analysis()`` FLOPs/bytes,
+    first-call wall) and per-bucket dispatch-to-settle timings, fed by
+    the engines at the dispatch site — one profiler per cache, so a
+    multi-model tenancy's whole executable population lands in one
+    ``/statusz`` table."""
 
     def __init__(self, guard: Optional[TracingGuard] = None):
         self._entries: Dict[Tuple, Callable] = {}
         self.compilations = 0
         self.guard = guard if guard is not None else TracingGuard()
+        self.profiler = telemetry.ExecutableProfiler()
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -445,8 +453,17 @@ class StreamingGameScorer:
         """Upload one padded batch and launch its bucket executable
         (async — the returned device array is a future; the ``dispatch``
         span measures upload + enqueue, and the device time surfaces as
-        ``device_wait`` where the InFlightWindow later blocks)."""
+        ``device_wait`` where the InFlightWindow later blocks).
+
+        A build (cache miss) additionally feeds the cache's profiler:
+        ``fn.lower(*args)`` is timed for lower wall + static
+        FLOPs/bytes (tracing only — no XLA compile, no jit-cache entry,
+        TracingGuard counts untouched), and the first invocation — which
+        runs trace + XLA compile synchronously before enqueueing — is
+        timed as the compile-wall proxy. Steady-state dispatches skip
+        both branches entirely."""
         with span("dispatch"):
+            before = self.cache.compilations
             fn = self.cache.get_or_build(
                 key, lambda: self._build_fn(*key[0]))
             args = host_args
@@ -459,6 +476,14 @@ class StreamingGameScorer:
             _M_DISPATCHES.inc()
             if self._m_dispatches is not None:
                 self._m_dispatches.inc()
+            if self.cache.compilations != before:
+                prof = self.cache.profiler
+                prof.profile_build(key, fn, (*args, self._params),
+                                   rows_bucket=key[0][0])
+                t0 = time.perf_counter()
+                out = fn(*args, self._params)
+                prof.record_first_call(key, time.perf_counter() - t0)
+                return out
             return fn(*args, self._params)
 
     #: _stats keys rolled back by :meth:`rollback_stats` — request/row
@@ -555,16 +580,21 @@ class StreamingGameScorer:
         win = InFlightWindow(self.pipeline_depth)
 
         def settle(done):
-            out, idxs, splits, t_start = done
+            out, idxs, splits, t_start, rows_b, t_disp = done
             host = np.asarray(out)
+            now = time.perf_counter()
             # One shared dispatch: every request in the group waited the
             # same wall time from featureization to settled result.
-            lat = time.perf_counter() - t_start
-            for idx, chunk in zip(idxs, np.split(
-                    host[:sum(datasets[i].num_rows for i in idxs)],
-                    splits)):
+            lat = now - t_start
+            n_real = sum(datasets[i].num_rows for i in idxs)
+            for idx, chunk in zip(idxs, np.split(host[:n_real], splits)):
                 results[idx] = chunk
             self._observe_latency(lat, n=len(idxs))
+            # Dispatch-to-settle wall per rows bucket, at the existing
+            # block_until_ready boundary (the window already synced) —
+            # the per-bucket device-time view on /statusz.
+            self.cache.profiler.record_dispatch(rows_b, now - t_disp,
+                                                n_real)
 
         for g in groups:
             if len(g) == 1 and datasets[g[0]].num_rows \
@@ -577,7 +607,8 @@ class StreamingGameScorer:
             with span("assemble"):
                 key, args, splits = self._assemble(reqs)
             out = self._dispatch(key, args)
-            done = win.push((out, g, splits, t_start), ready=out)
+            done = win.push((out, g, splits, t_start, key[0][0],
+                             time.perf_counter()), ready=out)
             if done is not None:
                 settle(done)
         for done in win.drain():
@@ -594,11 +625,14 @@ class StreamingGameScorer:
         pending: List[np.ndarray] = []
 
         def settle(done):
-            out, n_real, t_start = done
+            out, n_real, t_start, rows_b, t_disp = done
             pending.append(np.asarray(out)[:n_real])
+            now = time.perf_counter()
+            self.cache.profiler.record_dispatch(rows_b, now - t_disp,
+                                                n_real)
             if t_start is None:  # not the dataset's last piece
                 return None
-            self._observe_latency(time.perf_counter() - t_start)
+            self._observe_latency(now - t_start)
             res = (pending[0] if len(pending) == 1
                    else np.concatenate(pending))
             pending.clear()
@@ -623,7 +657,8 @@ class StreamingGameScorer:
                 out = self._dispatch(key, args)
                 done = win.push(
                     (out, piece.num_rows,
-                     t_req if pi == len(pieces) - 1 else None),
+                     t_req if pi == len(pieces) - 1 else None,
+                     key[0][0], time.perf_counter()),
                     ready=out)
                 if done is not None:
                     res = settle(done)
@@ -706,6 +741,14 @@ class StreamingGameScorer:
         s.update(self.cache_info())
         if self.metrics_label:
             s["metrics_label"] = self.metrics_label
+        else:
+            # Per-key compile economics + per-bucket dispatch-to-settle
+            # table (telemetry/profiler.py). The profiler is
+            # CACHE-scoped; a labeled engine is frontend-resident and
+            # the front-end's stats()["cache"]["profiler"] carries the
+            # one shared copy — rendering it again per engine would
+            # repeat the identical table N times per /statusz scrape.
+            s["profiler"] = self.cache.profiler.table()
         h = self._h_latency if self._h_latency is not None \
             else _H_REQUEST_LATENCY
         s["request_latency_seconds"] = h.snapshot()

@@ -51,7 +51,8 @@ import numpy as np
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.serving.buckets import BucketLadder
 from photon_ml_tpu.serving.engine import ExecutableCache, StreamingGameScorer
-from photon_ml_tpu.telemetry import span
+from photon_ml_tpu.telemetry import NOOP_CONTEXT, mint, span, trace_tail
+from photon_ml_tpu.telemetry import tracectx as _tracectx
 from photon_ml_tpu.utils.tracing_guard import TracingGuard
 
 # Process-wide front-end metrics (no-ops while telemetry is off).
@@ -73,8 +74,11 @@ _M_CANCELLED = telemetry.counter("serving.frontend.cancelled")
 _M_GROUPS = telemetry.counter("serving.frontend.coalesced_groups")
 _M_SWAPS = telemetry.counter("serving.frontend.model_swaps")
 _H_QUEUE_WAIT = telemetry.histogram("serving.frontend.queue_wait_seconds")
+# Exemplar-bearing (tracectx.py): each latency bucket remembers the last
+# trace_id that landed in it, rendered in OpenMetrics exemplar syntax on
+# /metrics — a P99 bucket links straight to its /tracez timeline.
 _H_LATENCY = telemetry.histogram(
-    "serving.frontend.request_latency_seconds")
+    "serving.frontend.request_latency_seconds", exemplars=True)
 #: pow-2 buckets 1..4096 — group sizes quantize like the row ladder.
 _H_GROUP_REQUESTS = telemetry.histogram(
     "serving.frontend.coalesce_group_requests",
@@ -102,7 +106,7 @@ class RequestRejected(FrontendError):
     later instead of queueing into a latency cliff."""
 
     def __init__(self, model: str, pending: int, limit: int,
-                 scope: str = "process"):
+                 scope: str = "process", trace_id: Optional[str] = None):
         what = ("max_pending" if scope == "process"
                 else "max_pending_per_model")
         super().__init__(
@@ -113,6 +117,9 @@ class RequestRejected(FrontendError):
         self.pending = pending
         self.limit = limit
         self.scope = scope
+        # The shed's trace context id (tail-sampled: every shed keeps
+        # its timeline, so callers can resolve this against /tracez).
+        self.trace_id = trace_id
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,13 +155,26 @@ class FrontendConfig:
 @dataclasses.dataclass
 class _Pending:
     """One admitted request: engine pinned at admission (hot-swap can
-    never re-route it), future settled at scatter-back."""
+    never re-route it), future settled at scatter-back. ``ctx`` is the
+    request's trace context (telemetry/tracectx.py) — it travels WITH
+    the request across every thread hop (event loop -> coalesce ->
+    dispatch executor -> scatter), which is exactly what the per-thread
+    span stacks cannot do; the solo-retry fault-isolation path keeps the
+    same object, so a retried request keeps its original trace_id."""
 
     data: object
     model: str
     engine: StreamingGameScorer
     future: asyncio.Future
     t_admit: float
+    # None on the default path: the request's trace materializes at
+    # settle (TraceTail.settle_batch) from t_admit + the group-shared
+    # stage stamps, so the admit hot path allocates nothing. A context
+    # object rides here only when the caller handed one in (``trace=``)
+    # or the solo-retry path materialized one mid-flight — either way
+    # it travels WITH the request across every thread hop, so a retried
+    # request keeps its original trace_id.
+    ctx: object = None
 
 
 class ServingFrontend:
@@ -298,12 +318,25 @@ class ServingFrontend:
 
     # -- request path ------------------------------------------------------
 
-    async def score(self, data, model: str = "default") -> np.ndarray:
+    async def score(self, data, model: str = "default",
+                    trace: Optional[object] = None) -> np.ndarray:
         """Admit one scoring request and await its result (host
         f[n_rows], same contract as ``StreamingGameScorer.score``).
         Raises :class:`RequestRejected` under overload and
         :class:`UnknownModelError` for a non-resident model — both
-        BEFORE admission, so a rejected request costs microseconds."""
+        BEFORE admission, so a rejected request costs microseconds.
+
+        Every request — admitted OR shed — gets a trace (``trace`` lets
+        a protocol front door hand in a context it minted at the
+        socket). Sheds/errors finish their context immediately and the
+        tail keeps ALL of them; admitted requests settle at scatter-back
+        with the full admit -> coalesce -> dispatch -> settle timeline.
+        On the default path the admitted-request trace is DEFERRED: the
+        hot path records nothing beyond the ``t_admit`` the _Pending
+        already carries, and the tail materializes kept timelines in one
+        batched settle per group (tracectx.settle_batch) — which is what
+        keeps sampling under the 2% overhead gate at coalesced serving
+        rates."""
         if self._batcher_task is None:
             raise FrontendError("frontend not started (use 'async with' "
                                 "or await start())")
@@ -314,21 +347,33 @@ class ServingFrontend:
             raise FrontendError("frontend is closing; request refused")
         engine = self._engines.get(model)
         if engine is None:
+            ctx = trace if trace is not None else mint("request")
+            ctx.annotate(model=model)
+            ctx.finish("error")
             raise UnknownModelError(model, self._engines)
         if self._pending >= self.config.max_pending:
             self._reject(model)
+            ctx = trace if trace is not None else mint("request")
+            ctx.annotate(model=model, scope="process")
+            ctx.finish("shed")
             raise RequestRejected(model, self._pending,
-                                  self.config.max_pending)
+                                  self.config.max_pending,
+                                  trace_id=ctx.trace_id)
         quota = self.config.max_pending_per_model
         model_pending = self._pending_by_model.get(model, 0)
         if quota is not None and model_pending >= quota:
             # Per-model shed: THIS tenant is at its quota; the process
             # still has headroom, so other models keep admitting.
             self._reject(model)
+            ctx = trace if trace is not None else mint("request")
+            ctx.annotate(model=model, scope="model")
+            ctx.finish("shed")
             raise RequestRejected(model, model_pending, quota,
-                                  scope="model")
+                                  scope="model", trace_id=ctx.trace_id)
+        if trace is not None:
+            trace.event("admit")
         fut = self._loop.create_future()
-        p = _Pending(data, model, engine, fut, time.perf_counter())
+        p = _Pending(data, model, engine, fut, time.perf_counter(), trace)
         self._pending += 1
         self._pending_by_model[model] = model_pending + 1
         # The registry twin of this counter is batch-incremented at
@@ -420,20 +465,30 @@ class ServingFrontend:
                     parts[key] = []
                     order.append(key)
                 parts[key].append(p)
+            # Group-shared trace stamp: every window-mate coalesced at
+            # this instant — recorded once per group and merged into
+            # each request's timeline at finish (one call per request,
+            # not one event per stage — the sampled hot path stays
+            # under the overhead gate).
+            t_coalesce = time.perf_counter()
             for key in order:
                 items = parts[key]
                 self._stats["dispatch_groups"] += 1
-                task = self._loop.create_task(self._dispatch_group(items))
+                task = self._loop.create_task(
+                    self._dispatch_group(items, t_coalesce))
                 self._dispatch_tasks.add(task)
                 task.add_done_callback(self._dispatch_tasks.discard)
 
-    def _score_group(self, engine: StreamingGameScorer,
-                     datasets: List) -> List:
+    def _score_group(self, items: List[_Pending]) -> Tuple[List, float]:
         """Executor-thread body: one coalesced ``score_many`` pass;
-        per-request (result, error) pairs. A malformed request must not
-        poison the callers it happened to share a window with, so a
-        failing group retries per-request and only the offender errors
-        (fault isolation; counted in ``isolation_splits``).
+        per-request (result, error) pairs plus the dispatch-start
+        timestamp (the group-shared ``dispatch`` trace stage). A
+        malformed request must not poison the callers it happened to
+        share a window with, so a failing group retries per-request and
+        only the offender errors (fault isolation; counted in
+        ``isolation_splits``). Each retried request keeps its ORIGINAL
+        trace context (the ``_Pending`` travels whole), with a
+        ``retry_solo`` event marking the isolation hop.
 
         Accounting on the retry path is EXACT: a failed ``score_many``
         attempt may have counted requests whose internal dispatch group
@@ -447,57 +502,118 @@ class ServingFrontend:
         caveat; regression-tested in tests/test_serving_frontend.py).
         Latency histograms are deliberately not rolled back — see
         ``rollback_stats``."""
+        t_dispatch = time.perf_counter()
+        engine = items[0].engine
+        datasets = [p.data for p in items]
         ckpt = engine.stats_checkpoint()
         try:
-            return [(r, None) for r in engine.score_many(datasets)]
+            return ([(r, None) for r in engine.score_many(datasets)],
+                    t_dispatch)
         except Exception:  # noqa: BLE001 — isolate, then re-raise solo
             engine.rollback_stats(ckpt)
             if len(datasets) == 1:
                 raise
         self._stats["isolation_splits"] += 1
         out = []
-        for ds in datasets:
+        for p in items:
+            if p.ctx is None:
+                # The isolation path is rare and interesting — give the
+                # request a real context now (backdated to admission)
+                # so its retry hop is on the timeline; it keeps this
+                # trace_id from here on.
+                ctx = mint("request")
+                if ctx is not NOOP_CONTEXT:
+                    # Backdate BOTH clocks by the same delta, so the
+                    # wall anchor stays consistent with the duration
+                    # measured from admission.
+                    ctx.start_unix -= ctx.t0 - p.t_admit
+                    ctx.t0 = p.t_admit
+                p.ctx = ctx
+            p.ctx.event("retry_solo")
             ckpt = engine.stats_checkpoint()
             try:
-                out.append((engine.score_many([ds])[0], None))
+                out.append((engine.score_many([p.data])[0], None))
             except Exception as e:  # noqa: BLE001 — per-request verdict
                 engine.rollback_stats(ckpt)
                 out.append((None, e))
-        return out
+        return out, t_dispatch
 
-    async def _dispatch_group(self, items: List[_Pending]) -> None:
-        engine = items[0].engine
-        datasets = [p.data for p in items]
+    async def _dispatch_group(self, items: List[_Pending],
+                              t_coalesce: float) -> None:
+        t_dispatch = None
         try:
-            results = await self._loop.run_in_executor(
-                self._pool, self._score_group, engine, datasets)
+            results, t_dispatch = await self._loop.run_in_executor(
+                self._pool, self._score_group, items)
         except Exception as e:  # noqa: BLE001 — fail the whole group
             results = [(None, e)] * len(items)
         with span("scatter"):
             now = time.perf_counter()
+            # One shared stage dict per settled group — merged into each
+            # kept request's timeline (finish() for materialized
+            # contexts, settle_batch for deferred ones).
+            stages = {"coalesce": t_coalesce, "settle": now}
+            if t_dispatch is not None:
+                stages["dispatch"] = t_dispatch
+            sampling = _tracectx.enabled()
             lats: List[float] = []
+            exemplar_ids: List = []
+            deferred: List = []  # settle_batch entries
             n_failed = 0
             n_cancelled = 0
             for p, (res, err) in zip(items, results):
                 if p.future.done():  # caller cancelled; nothing to route
                     self._stats["cancelled"] += 1
                     n_cancelled += 1
-                    continue
-                if err is None:
+                    outcome = "cancelled"
+                    slot = None
+                elif err is None:
                     p.future.set_result(res)
                     self._stats["completed"] += 1
+                    outcome = "ok"
                     lats.append(now - p.t_admit)
+                    exemplar_ids.append(None)
+                    slot = len(lats) - 1
                 else:
                     p.future.set_exception(err)
                     self._stats["failed"] += 1
                     n_failed += 1
+                    outcome = "error"
+                    slot = None
+                ctx = p.ctx
+                if ctx is not None:
+                    if outcome == "error":
+                        ctx.annotate(error=type(err).__name__)
+                    ctx.finish(outcome, stages=stages)
+                    # Exemplars must RESOLVE: only a tail-kept trace's
+                    # id lands on a bucket (same invariant the deferred
+                    # path gets from settle_batch minting ids for kept
+                    # entries only).
+                    if slot is not None and ctx.kept:
+                        exemplar_ids[slot] = ctx.trace_id
+                elif sampling:
+                    deferred.append((
+                        p.t_admit, now - p.t_admit, outcome,
+                        (type(err).__name__ if err is not None
+                         else None), slot))
+            if deferred:
+                # ONE lock for the whole group; kept ok-entries come
+                # back with their minted ids for exemplar stamping.
+                for slot, tid in trace_tail().settle_batch(
+                        deferred, stages).items():
+                    exemplar_ids[slot] = tid
             if n_failed:
                 _M_FAILED.inc(n_failed)
             if n_cancelled:
                 _M_CANCELLED.inc(n_cancelled)
             if lats:  # one locked batch per settled group
                 _M_COMPLETED.inc(len(lats))
-                _H_LATENCY.observe_many(lats)
+                # Exemplars only when sampling produced ids (kept
+                # traces) — otherwise skip the per-sample loop.
+                _H_LATENCY.observe_many(
+                    lats, exemplars=(exemplar_ids
+                                     if any(t is not None
+                                            for t in exemplar_ids)
+                                     else None))
 
     # -- replay harness ----------------------------------------------------
 
@@ -605,7 +721,8 @@ class ServingFrontend:
             "coalesce_group_requests": _H_GROUP_REQUESTS.snapshot(),
             "cache": {"entries": len(self.cache),
                       "compilations": self.cache.compilations,
-                      "traces": self.cache.total_traces()},
+                      "traces": self.cache.total_traces(),
+                      "profiler": self.cache.profiler.table()},
             "engines": {name: eng.stats()
                         for name, eng in sorted(self._engines.items())},
         }

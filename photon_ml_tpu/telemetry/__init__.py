@@ -30,7 +30,6 @@ from photon_ml_tpu.telemetry.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
-    disable,
     enabled,
     registry,
 )
@@ -58,23 +57,46 @@ from photon_ml_tpu.telemetry.slo import (
     SLOTracker,
     parse_slo,
 )
+from photon_ml_tpu.telemetry import tracectx as _tracectx_mod
+from photon_ml_tpu.telemetry.tracectx import (
+    NOOP_CONTEXT,
+    TraceContext,
+    TraceTail,
+    mint,
+    trace_tail,
+)
+from photon_ml_tpu.telemetry.profiler import ExecutableProfiler
 
 
-def enable(trace: bool = False) -> None:
+def enable(trace: bool = False, sampling: bool = True) -> None:
     """Turn telemetry on for this process; ``trace=True`` additionally
     records raw span events for Chrome-trace export (aggregation is
-    always on while enabled)."""
+    always on while enabled). ``sampling`` (default on) arms
+    request-scoped trace contexts + tail sampling (tracectx.py) —
+    the bench prices it separately by passing False."""
     tracer().record_events = bool(trace)
     _registry_mod.enable()
+    if sampling:
+        _tracectx_mod.enable()
+    else:
+        _tracectx_mod.disable()
+
+
+def disable() -> None:
+    """Turn the whole layer off: metric mutations, span recording, and
+    trace-context sampling all return to their no-op fast paths."""
+    _registry_mod.disable()
+    _tracectx_mod.disable()
 
 
 def reset() -> None:
-    """Zero all metrics and drop recorded spans; re-binds the tracer's
-    main thread to the caller. Drivers call this at startup so a
-    process that runs several in sequence (tests) reports per-run
-    telemetry."""
+    """Zero all metrics, drop recorded spans and sampled traces;
+    re-binds the tracer's main thread to the caller. Drivers call this
+    at startup so a process that runs several in sequence (tests)
+    reports per-run telemetry."""
     registry().reset()
     tracer().reset()
+    trace_tail().reset()
 
 
 def counter(name: str) -> Counter:
@@ -85,8 +107,9 @@ def gauge(name: str) -> Gauge:
     return registry().gauge(name)
 
 
-def histogram(name: str, buckets=None) -> Histogram:
-    return registry().histogram(name, buckets)
+def histogram(name: str, buckets=None,
+              exemplars: bool = False) -> Histogram:
+    return registry().histogram(name, buckets, exemplars=exemplars)
 
 
 def snapshot() -> dict:
@@ -96,14 +119,18 @@ def snapshot() -> dict:
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "Counter",
+    "ExecutableProfiler",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "LatencyObjective",
     "MetricsRegistry",
+    "NOOP_CONTEXT",
     "ObservabilityServer",
     "RatioObjective",
     "SLOTracker",
+    "TraceContext",
+    "TraceTail",
     "Tracer",
     "attribution_summary",
     "counter",
@@ -114,6 +141,7 @@ __all__ = [
     "gauge",
     "histogram",
     "install_sigterm_dump",
+    "mint",
     "parse_slo",
     "prometheus_name",
     "registry",
@@ -123,5 +151,6 @@ __all__ = [
     "span",
     "stage_attribution",
     "timed_span",
+    "trace_tail",
     "tracer",
 ]

@@ -52,6 +52,7 @@ from typing import Callable, Dict, Optional
 # function — same discipline as spans.py.
 _reg = importlib.import_module("photon_ml_tpu.telemetry.registry")
 _spans = importlib.import_module("photon_ml_tpu.telemetry.spans")
+_tracectx = importlib.import_module("photon_ml_tpu.telemetry.tracectx")
 
 _INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -89,7 +90,8 @@ def _escape_help(text: str) -> str:
     return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
-def render_prometheus(registry: Optional[_reg.MetricsRegistry] = None) -> str:
+def render_prometheus(registry: Optional[_reg.MetricsRegistry] = None,
+                      include_exemplars: bool = False) -> str:
     """Render the registry in Prometheus text exposition format 0.0.4.
 
     Per metric family: ``# HELP`` (carrying the original dotted registry
@@ -101,7 +103,14 @@ def render_prometheus(registry: Optional[_reg.MetricsRegistry] = None) -> str:
     consistent even under concurrent observation. In the (schema-
     violating) event two dotted names sanitize to one Prometheus name,
     the first wins and the collision is reported as a comment rather
-    than emitting an invalid duplicate family."""
+    than emitting an invalid duplicate family.
+
+    ``include_exemplars`` appends each bucket's last trace_id in
+    OpenMetrics exemplar syntax. That syntax is ILLEGAL in text 0.0.4
+    (a mid-line ``#`` fails a strict 0.0.4 parser, losing the whole
+    scrape), so it is opt-in: the observability server enables it only
+    when the scraper's ``Accept`` header negotiates OpenMetrics, and
+    serves the matching content type + ``# EOF`` terminator."""
     reg = registry if registry is not None else _reg.registry()
     counters, gauges, histograms = reg.metrics()
     out = []
@@ -136,16 +145,34 @@ def render_prometheus(registry: Optional[_reg.MetricsRegistry] = None) -> str:
         pname = prometheus_name(name)
         if not claim(pname, name):
             continue
-        bounds, cum, count, total = histograms[name].exposition_state()
+        hist = histograms[name]
+        bounds, cum, count, total = hist.exposition_state()
+        # Exemplars (last trace_id per bucket) — only on negotiated
+        # OpenMetrics renders (see docstring). Read once, outside the
+        # bucket loop; advisory data (see Histogram.exemplars()).
+        exemplars = hist.exemplars() if include_exemplars else {}
         out.append(f"# HELP {pname} "
                    f"{_escape_help('registry histogram ' + name)}")
         out.append(f"# TYPE {pname} histogram")
         for b, c in zip(bounds, cum):
-            out.append(f'{pname}_bucket{{le="{_fmt_value(b)}"}} {c}')
-        out.append(f'{pname}_bucket{{le="+Inf"}} {count}')
+            line = f'{pname}_bucket{{le="{_fmt_value(b)}"}} {c}'
+            out.append(line + _fmt_exemplar(exemplars.get(b)))
+        out.append(f'{pname}_bucket{{le="+Inf"}} {count}'
+                   + _fmt_exemplar(exemplars.get("+inf")))
         out.append(f"{pname}_sum {_fmt_value(total)}")
         out.append(f"{pname}_count {count}")
     return "\n".join(out) + "\n"
+
+
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix for a bucket sample line:
+    `` # {trace_id="..."} <value> <unix_ts>`` — empty when the bucket
+    has none."""
+    if ex is None:
+        return ""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{trace_id}"}} {_fmt_value(value)} '
+            f"{_fmt_value(round(ts, 3))}")
 
 
 def _json_default(o):
@@ -190,7 +217,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                      "routes": sorted(obs._routes)}) + "\n",
                     "application/json")
                 return
-            body, ctype = route()
+            body, ctype = route(self.headers.get("Accept", ""))
             self._send(200, body, ctype)
         except BrokenPipeError:
             pass  # scraper went away mid-response
@@ -242,6 +269,13 @@ class ObservabilityServer:
         self.dump_path = dump_path
         self.scrapes = 0  # plain int: live even with telemetry disabled
         self._m_scrapes = _reg.registry().counter("observability.scrapes")
+        # A /statusz provider that raises is isolated (its error reports
+        # inline) — but silent isolation hid broken providers for a
+        # whole run. Count them (registry counter + always-live local
+        # twin) and surface the failing names in the payload.
+        self._m_provider_errors = _reg.registry().counter(
+            "obs.provider_errors")
+        self._provider_errors: Dict[str, int] = {}
         self._providers: Dict[str, Callable[[], dict]] = dict(
             status_providers or {})
         self._httpd: Optional[_ObsHTTPServer] = None
@@ -254,29 +288,49 @@ class ObservabilityServer:
             "/healthz": self._healthz,
             "/statusz": self._statusz,
             "/debugz/dump": self._debugz_dump,
+            "/tracez": self._tracez,
         }
 
     # -- routes ------------------------------------------------------------
 
-    def _metrics(self):
+    def _metrics(self, accept: str = ""):
         self.scrapes += 1
         self._m_scrapes.inc()
+        # Content negotiation: exemplar syntax is only legal under
+        # OpenMetrics, so a plain scraper gets clean text 0.0.4 (no
+        # exemplars — a mid-line '#' would fail its whole scrape) and
+        # an Accept: application/openmetrics-text scraper gets the
+        # exemplar-bearing render + '# EOF' terminator. The OpenMetrics
+        # render reuses the 0.0.4 family layout (counters keep _total
+        # in their TYPE line — a documented simplification consumers
+        # like Grafana's agent accept).
+        if "openmetrics" in accept:
+            return (render_prometheus(include_exemplars=True)
+                    + "# EOF\n",
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8")
         return (render_prometheus(),
                 "text/plain; version=0.0.4; charset=utf-8")
 
-    def _healthz(self):
+    def _healthz(self, accept: str = ""):
         return (json.dumps({
             "status": "ok",
             "uptime_seconds": round(time.monotonic() - self._t0, 3),
         }) + "\n", "application/json")
 
-    def _statusz(self):
+    def _statusz(self, accept: str = ""):
         status = {}
+        failing = []
         for name, fn in sorted(self._providers.items()):
             try:
                 status[name] = fn()
             except Exception as e:  # noqa: BLE001 — report, don't 500
-                status[name] = {"error": f"{type(e).__name__}: {e}"}
+                status[name] = {"provider": name,
+                                "error": f"{type(e).__name__}: {e}"}
+                failing.append(name)
+                self._provider_errors[name] = \
+                    self._provider_errors.get(name, 0) + 1
+                self._m_provider_errors.inc()
         body = {
             "uptime_seconds": round(time.monotonic() - self._t0, 3),
             "scrapes": self.scrapes,
@@ -284,6 +338,8 @@ class ObservabilityServer:
             "metrics": _reg.registry().snapshot(),
             "stage_attribution": _spans.stage_attribution(),
             "status": status,
+            "failing_providers": failing,
+            "provider_errors": dict(self._provider_errors),
             "slo": (self.slo_tracker.evaluate()
                     if self.slo_tracker is not None else None),
             "flight_recorder": (self.recorder.stats()
@@ -292,7 +348,16 @@ class ObservabilityServer:
         return (json.dumps(body, indent=2, default=_json_default) + "\n",
                 "application/json")
 
-    def _debugz_dump(self):
+    def _tracez(self, accept: str = ""):
+        """Tail-sampled trace timelines (telemetry/tracectx.py): every
+        shed/error/cancellation, the slowest decile, and a uniform
+        floor — the per-request view the aggregate routes cannot
+        give."""
+        return (json.dumps(_tracectx.trace_tail().snapshot(), indent=2,
+                           default=_json_default) + "\n",
+                "application/json")
+
+    def _debugz_dump(self, accept: str = ""):
         if self.recorder is None:
             return (json.dumps({"error": "no flight recorder installed "
                                          "(driver --flight-events 0?)"})

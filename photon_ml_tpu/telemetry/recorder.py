@@ -46,6 +46,7 @@ from typing import Dict
 # accessor function (see spans.py).
 _reg = importlib.import_module("photon_ml_tpu.telemetry.registry")
 _spans = importlib.import_module("photon_ml_tpu.telemetry.spans")
+_tracectx = importlib.import_module("photon_ml_tpu.telemetry.tracectx")
 
 
 class FlightRecorder:
@@ -130,14 +131,20 @@ class FlightRecorder:
 
     # -- dumping -----------------------------------------------------------
 
-    def dump(self, path=None, reason: str = "manual") -> dict:
+    def dump(self, path=None, reason: str = "manual",
+             trace_id: str = None) -> dict:
         """Build (and optionally write) the flight dump: Chrome
         trace-event JSON (``traceEvents``: the ring's spans as ``ph: X``
         slices on per-thread tracks, registry deltas as ``ph: C``
         counter samples — Perfetto renders both) plus a ``flight`` block
-        carrying the final registry snapshot and stage attribution.
-        Timestamps share the tracer's epoch, so a flight dump and a
-        ``--trace-out`` trace of the same run line up."""
+        carrying the final registry snapshot, stage attribution, and the
+        tail-sampled trace timelines (telemetry/tracectx.py — the dump
+        carries the same per-request/per-solve evidence as a live
+        ``/tracez`` scrape). ``trace_id`` tags the dump with the
+        request/solve the fault belongs to (e.g. a diverged solve's
+        context — ``flight.trace_id``). Timestamps share the tracer's
+        epoch, so a flight dump and a ``--trace-out`` trace of the same
+        run line up."""
         tr = _spans.tracer()
         with self._lock:
             events = list(self._ring)
@@ -170,6 +177,8 @@ class FlightRecorder:
                 "snapshot_interval_s": self.snapshot_interval_s,
                 "final_metrics": _reg.registry().snapshot(),
                 "stage_attribution": _spans.stage_attribution(),
+                "trace_id": trace_id,
+                "traces": _tracectx.trace_tail().snapshot(),
             },
         }
         if path is not None:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -134,10 +135,12 @@ class Histogram:
     """
 
     __slots__ = ("name", "_bounds", "_bounds_arr", "_counts", "_count",
-                 "_sum", "_min", "_max", "_lock")
+                 "_sum", "_min", "_max", "_lock", "_exemplars",
+                 "exemplars_declared")
 
     def __init__(self, name: str,
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 exemplars: bool = False):
         self.name = name
         bounds = tuple(sorted(buckets if buckets is not None
                               else DEFAULT_LATENCY_BUCKETS))
@@ -153,11 +156,31 @@ class Histogram:
         self._min = None
         self._max = None
         self._lock = threading.Lock()
+        # Last exemplar per bucket: (trace_id, value, unix_ts) or None.
+        # Declared histograms (``exemplars=True`` — lint-checked to end
+        # in ``_seconds`` by dev_scripts/metric_names.py) preallocate;
+        # undeclared ones allocate lazily on the first exemplar, so the
+        # common exemplar-free histogram stays two words lighter.
+        self.exemplars_declared = bool(exemplars)
+        self._exemplars = ([None] * (len(bounds) + 1)
+                           if exemplars else None)
 
-    def observe(self, value, n: int = 1) -> None:
+    def _set_exemplar(self, i: int, trace_id, v: float) -> None:
+        # Caller holds self._lock. trace_id None = no exemplar (the
+        # tracectx no-op context's id), so call sites stay branch-free.
+        if trace_id is None:
+            return
+        ex = self._exemplars
+        if ex is None:
+            ex = self._exemplars = [None] * len(self._counts)
+        ex[i] = (trace_id, v, time.time())
+
+    def observe(self, value, n: int = 1, exemplar=None) -> None:
         """Record ``value`` (``n`` times — a coalesced dispatch settles a
         whole group at one latency, so the serving hot path takes the
-        lock once per GROUP, not once per request)."""
+        lock once per GROUP, not once per request). ``exemplar`` (a
+        trace_id string, or None) stamps the landing bucket's exemplar
+        slot — the link from a /metrics bucket to a /tracez timeline."""
         if not _enabled:
             return
         v = float(value)
@@ -170,12 +193,16 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            if exemplar is not None:
+                self._set_exemplar(i, exemplar, v)
 
-    def observe_many(self, values) -> None:
+    def observe_many(self, values, exemplars=None) -> None:
         """Vectorized ``observe`` for per-request samples that DIFFER
         within a settled group (queue waits, end-to-end latencies): one
         searchsorted + one lock acquisition for the whole batch instead
-        of a locked bisect per sample."""
+        of a locked bisect per sample. ``exemplars`` (optional, aligned
+        with ``values``; entries may be None) stamps the LAST sample per
+        bucket as that bucket's exemplar."""
         if not _enabled:
             return
         v = np.asarray(values, dtype=float).ravel()
@@ -193,6 +220,10 @@ class Histogram:
                 self._min = lo
             if self._max is None or hi > self._max:
                 self._max = hi
+            if exemplars is not None:
+                for i, val, tid in zip(idx, v, exemplars):
+                    if tid is not None:
+                        self._set_exemplar(int(i), tid, float(val))
 
     @property
     def count(self) -> int:
@@ -254,6 +285,13 @@ class Histogram:
                "mean": (total / count if count else None),
                "min": mn, "max": mx}
         out.update(self.percentiles())
+        # Exemplars ride only when stamped (conditional key: the
+        # exemplar-free histogram snapshot schema is unchanged).
+        ex = self.exemplars()
+        if ex:
+            out["exemplars"] = {
+                str(b): {"trace_id": t, "value": v, "unix_ts": ts}
+                for b, (t, v, ts) in ex.items()}
         return out
 
     def bucket_counts(self) -> Dict:
@@ -263,12 +301,31 @@ class Histogram:
             out["+inf"] = self._counts[-1]
         return out
 
+    def exemplars(self) -> Dict:
+        """(upper-edge or "+inf") -> (trace_id, value, unix_ts) for
+        buckets that have one. Empty dict when none were ever stamped.
+        Advisory data — read under the lock so a concurrent observe
+        can't tear a tuple, but exposition pairs these with bucket
+        counts from a separate read (an exemplar is a POINTER into
+        /tracez, not part of the histogram's consistency contract)."""
+        with self._lock:
+            ex = self._exemplars
+            if ex is None:
+                return {}
+            out = {b: e for b, e in zip(self._bounds, ex)
+                   if e is not None}
+            if ex[-1] is not None:
+                out["+inf"] = ex[-1]
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * (len(self._bounds) + 1)
             self._count = 0
             self._sum = 0.0
             self._min = self._max = None
+            if self._exemplars is not None:
+                self._exemplars = [None] * len(self._counts)
 
 
 class MetricsRegistry:
@@ -298,11 +355,13 @@ class MetricsRegistry:
             return m
 
     def histogram(self, name: str,
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+                  buckets: Optional[Sequence[float]] = None,
+                  exemplars: bool = False) -> Histogram:
         with self._lock:
             m = self._histograms.get(name)
             if m is None:
-                m = self._histograms[name] = Histogram(name, buckets)
+                m = self._histograms[name] = Histogram(
+                    name, buckets, exemplars=exemplars)
             return m
 
     def metrics(self) -> Tuple[Dict[str, Counter], Dict[str, Gauge],

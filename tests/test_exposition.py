@@ -36,13 +36,28 @@ from photon_ml_tpu.telemetry.registry import MetricsRegistry
 _SAMPLE_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
     r'(?:\{(?P<labels>[^}]*)\})? '
-    r'(?P<value>[^ ]+)$')
+    r'(?P<value>[^ ]+)'
+    # Optional OpenMetrics exemplar suffix (PR 11): only histogram
+    # bucket lines may carry one; labels + value + unix timestamp.
+    r'(?: # \{(?P<exlabels>[^}]*)\} (?P<exvalue>[^ ]+) (?P<exts>[^ ]+))?$')
+
+
+def _parse_labels(raw: str) -> dict:
+    labels = {}
+    for pair in raw.split(","):
+        k, _, v = pair.partition("=")
+        assert v.startswith('"') and v.endswith('"'), raw
+        labels[k] = v[1:-1]
+    return labels
 
 
 def parse_prometheus(text: str):
     """text exposition -> {family: {"type": t, "help": h, "samples":
-    [(sample_name, labels_dict, float_value)]}}; raises AssertionError
-    on any malformed line (this parser IS the test oracle)."""
+    [(sample_name, labels_dict, float_value)], "exemplars":
+    [(sample_name, labels_dict, exemplar_dict)]}}; raises
+    AssertionError on any malformed line (this parser IS the test
+    oracle). Exemplars are validated structurally: bucket samples only,
+    labels parse, value and timestamp are floats."""
     assert text.endswith("\n"), "exposition must end with a newline"
     families = {}
     current = None
@@ -53,7 +68,7 @@ def parse_prometheus(text: str):
             _, _, rest = line.partition("# HELP ")
             name, _, help_text = rest.partition(" ")
             current = families[name] = {"type": None, "help": help_text,
-                                        "samples": []}
+                                        "samples": [], "exemplars": []}
         elif line.startswith("# TYPE "):
             _, _, rest = line.partition("# TYPE ")
             name, _, mtype = rest.partition(" ")
@@ -65,14 +80,19 @@ def parse_prometheus(text: str):
         else:
             m = _SAMPLE_RE.match(line)
             assert m, f"malformed sample line: {line!r}"
-            labels = {}
-            if m.group("labels"):
-                for pair in m.group("labels").split(","):
-                    k, _, v = pair.partition("=")
-                    assert v.startswith('"') and v.endswith('"'), line
-                    labels[k] = v[1:-1]
+            labels = (_parse_labels(m.group("labels"))
+                      if m.group("labels") else {})
             value = float(m.group("value"))
             sample = m.group("name")
+            exemplar = None
+            if m.group("exlabels") is not None:
+                assert sample.endswith("_bucket"), \
+                    f"exemplar on a non-bucket sample: {line!r}"
+                exemplar = {
+                    "labels": _parse_labels(m.group("exlabels")),
+                    "value": float(m.group("exvalue")),
+                    "ts": float(m.group("exts")),
+                }
             # samples attach to their family (histogram series carry
             # _bucket/_sum/_count suffixes)
             fam = None
@@ -84,6 +104,11 @@ def parse_prometheus(text: str):
                 fam = sample[:-len("_bucket")]
             assert fam in families, f"sample {sample!r} without HELP/TYPE"
             families[fam]["samples"].append((sample, labels, value))
+            if exemplar is not None:
+                assert families[fam]["type"] in (None, "histogram"), \
+                    f"exemplar on non-histogram family: {line!r}"
+                families[fam]["exemplars"].append(
+                    (sample, labels, exemplar))
     for name, fam in families.items():
         if fam["type"] == "histogram":
             buckets = [(float(la["le"]) if la["le"] != "+Inf"
@@ -260,6 +285,19 @@ def test_server_routes(tmp_path):
             assert "solve" in sz["stage_attribution"]
             assert sz["status"]["demo"] == {"x": 1}
             assert "ZeroDivisionError" in sz["status"]["broken"]["error"]
+            # Broken providers are isolated AND visible: the failing
+            # name surfaces in the payload and the obs.provider_errors
+            # counter moves (PR 11 satellite — previously silent).
+            assert sz["status"]["broken"]["provider"] == "broken"
+            assert sz["failing_providers"] == ["broken"]
+            assert sz["provider_errors"] == {"broken": 1}
+            assert telemetry.counter("obs.provider_errors").value == 1
+            sz2 = json.loads(_get(port, "/statusz").read())
+            assert sz2["provider_errors"] == {"broken": 2}
+            # /tracez serves the tail sampler (empty here; semantics in
+            # tests/test_tracectx.py)
+            tz = json.loads(_get(port, "/tracez").read())
+            assert tz["seen"] == 0 and "traces" in tz
             assert "p99_serving_frontend_request_latency_seconds" \
                 in sz["slo"]
             assert sz["flight_recorder"]["events_in_ring"] >= 1

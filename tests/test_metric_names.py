@@ -87,5 +87,36 @@ def test_fully_dynamic_name_is_runtime_problem(tmp_path):
     assert violations(tmp_path, TELEM + "counter(name_var)\n") == []
 
 
+def test_exemplar_histogram_must_name_seconds(tmp_path):
+    # TP: exemplar-bearing histogram without a _seconds suffix
+    bad = TELEM + 'histogram("serving.request_count", exemplars=True)\n'
+    out = violations(tmp_path, bad)
+    assert len(out) == 1 and out[0][0] == "exemplar-histogram-name"
+    # FP guards: _seconds-suffixed declaration, explicit False, and a
+    # plain histogram are all clean
+    ok = (TELEM +
+          'histogram("serving.latency_seconds", exemplars=True)\n'
+          'histogram("serving.group_rows", exemplars=False)\n'
+          'histogram("serving.other_rows")\n')
+    assert violations(tmp_path, ok) == []
+
+
+def test_exemplar_declaration_conflict_flagged(tmp_path):
+    # explicit True at one site + explicit False at another: conflict
+    src = (TELEM +
+           'histogram("serving.latency_seconds", exemplars=True)\n'
+           'histogram("serving.latency_seconds", exemplars=False)\n')
+    out = violations(tmp_path, src)
+    assert any(rule == "exemplar-declaration-conflict"
+               for rule, _ in out)
+    assert not any(rule == "metric-type-conflict" for rule, _ in out)
+    # a kwarg-less READ of the same name (bench snapshots do this) is
+    # NOT a conflicting declaration
+    ok = (TELEM +
+          'histogram("serving.latency_seconds", exemplars=True)\n'
+          'histogram("serving.latency_seconds")\n')
+    assert violations(tmp_path, ok) == []
+
+
 def test_repo_tree_is_clean():
     assert metric_names.main(["--root", str(REPO)]) == 0

@@ -1,0 +1,457 @@
+"""Request-scoped tracing (photon_ml_tpu/telemetry/tracectx.py), the
+exemplar plumbing, the executable profiler, and the divergence watchdog:
+context propagation across the front-end's thread hops (solo-retry keeps
+its original trace_id), tail-sampling classes, /tracez + exemplar
+rendering under concurrent mutation, and the watchdog-triggered flight
+dump contents."""
+
+import asyncio
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.optimization.convergence import (
+    SolverDivergedError,
+    check_solver_finite,
+)
+from photon_ml_tpu.serving import (
+    BucketLadder,
+    FrontendConfig,
+    RequestRejected,
+    ServingFrontend,
+)
+from photon_ml_tpu.telemetry import ObservabilityServer, mint, trace_tail
+from photon_ml_tpu.telemetry.tracectx import NOOP_CONTEXT, TraceTail
+
+from tests.test_exposition import parse_prometheus
+from tests.test_serving_frontend import (
+    DT,
+    LADDER,
+    _dataset,
+    _game_model,
+    _singles,
+)
+
+
+@pytest.fixture
+def sampling():
+    """Telemetry + trace sampling on, everything clean before/after."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        yield
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# -- context + tail unit semantics -----------------------------------------
+
+def test_mint_disabled_returns_shared_noop():
+    telemetry.disable()
+    ctx = mint("request")
+    assert ctx is NOOP_CONTEXT and ctx is mint("solve")
+    assert ctx.trace_id is None
+    ctx.event("x")
+    ctx.annotate(a=1)
+    ctx.finish("error")  # must not reach the tail
+    assert trace_tail().snapshot()["seen"] == 0
+
+
+def test_context_timeline_and_tail_classes(sampling):
+    tail = TraceTail(floor_every=4, slow_capacity=8, error_capacity=8,
+                     floor_capacity=8)
+    # error outcomes always keep, with ordered timelines + annotations
+    ctx = mint("request")
+    ctx.event("admit")
+    ctx.annotate(model="m")
+    ctx.finish("shed")
+    # finish() reported to the PROCESS tail; replay the snapshot into
+    # the local one to test classification deterministically
+    assert ctx.outcome == "shed" and ctx.duration_s >= 0
+    tail.record(ctx)
+    snap = tail.snapshot()
+    assert snap["kept"]["error"] == 1
+    kept = snap["traces"]["error"][0]
+    assert kept["trace_id"] == ctx.trace_id
+    assert kept["annotations"] == {"model": "m"}
+    assert [e["stage"] for e in kept["events"]] == ["admit"]
+    # stamped stages merge into the timeline, time-ordered
+    ctx2 = mint("request")
+    ctx2.event("admit")
+    import time as _t
+
+    t_co, t_set = _t.perf_counter(), _t.perf_counter()
+    ctx2.finish("ok", stages={"coalesce": t_co, "settle": t_set})
+    found = trace_tail().find(ctx2.trace_id)
+    assert found is not None
+    stages = [e["stage"] for e in found["events"]]
+    assert stages == ["admit", "coalesce", "settle"]
+    # double-finish is idempotent
+    seen = trace_tail().snapshot()["seen"]
+    ctx2.finish("error")
+    assert trace_tail().snapshot()["seen"] == seen
+
+
+def test_tail_slow_decile_and_floor(sampling):
+    tail = TraceTail(floor_every=10, window=200)
+
+    def fake(duration, outcome="ok"):
+        ctx = telemetry.TraceContext("request")
+        ctx.outcome = outcome
+        ctx.duration_s = duration
+        return ctx
+
+    # 200 spread-out fast durations + sprinkled 1.0s outliers: after
+    # the window warms, the outliers land in the slow ring and sub-
+    # threshold traces land (every 10th) in the floor ring. Durations
+    # VARY (real traffic never produces byte-equal wall times) — with
+    # all-equal durations the inclusive p90 threshold would classify
+    # everything slow, which the bounded rings absorb by design.
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        tail.record(fake(1.0 if i % 50 == 49
+                         else 0.001 * (1 + rng.random())))
+    snap = tail.snapshot()
+    assert snap["slow_threshold_s"] is not None
+    assert snap["slow_threshold_s"] <= 1.0
+    slow_durs = [t["duration_s"] for t in snap["traces"]["slow"]]
+    assert 1.0 in slow_durs
+    assert snap["kept"]["floor"] >= 1
+    # floor entries are ordinary fast traces
+    assert all(t["duration_s"] <= snap["slow_threshold_s"]
+               for t in snap["traces"]["floor"])
+    # errors keep regardless of speed
+    tail.record(fake(0.0001, outcome="error"))
+    assert tail.snapshot()["kept"]["error"] == 1
+
+
+def test_event_cap_bounds_runaway_timelines(sampling):
+    ctx = mint("solve")
+    for i in range(2 * ctx.MAX_EVENTS):
+        ctx.event("solver_step")
+    assert len(ctx.events) == ctx.MAX_EVENTS
+    ctx.finish("ok")
+    found = trace_tail().find(ctx.trace_id)
+    if found is not None:  # kept (first traces always qualify as slow)
+        assert found["events_dropped"] is True
+
+
+# -- front-end propagation -------------------------------------------------
+
+@pytest.fixture
+def traced_frontend(rng, sampling):
+    train = _dataset(rng, n=80)
+    gm = _game_model(rng, train)
+    fe = ServingFrontend({"default": gm}, dtype=DT,
+                         ladder=BucketLadder(**LADDER),
+                         config=FrontendConfig(coalesce_window_s=0.05,
+                                               max_pending=256))
+    return fe, gm
+
+
+@pytest.mark.needs_f64
+def test_request_timeline_spans_admission_to_settle(traced_frontend):
+    """One coalesced window: every request's context crosses the event
+    loop -> dispatch-executor -> scatter hops with the full
+    admit -> coalesce -> dispatch -> settle timeline, and the latency
+    histogram's buckets carry resolvable trace_id exemplars."""
+    fe, gm = traced_frontend
+    reqs = _singles(500, 8)
+    ctxs = [mint("request") for _ in reqs]
+
+    async def run():
+        async with fe:
+            return await asyncio.gather(
+                *[fe.score(r, trace=c) for r, c in zip(reqs, ctxs)])
+
+    out = asyncio.run(run())
+    for r, o in zip(reqs, out):
+        np.testing.assert_allclose(o, gm.score(r), rtol=1e-10, atol=1e-10)
+    for ctx in ctxs:
+        assert ctx.outcome == "ok"
+        stages = [s for s, _ in sorted(ctx.events, key=lambda e: e[1])]
+        assert stages[0] == "admit" and stages[-1] == "settle"
+        assert "coalesce" in stages and "dispatch" in stages
+    # every latency exemplar resolves to a kept /tracez timeline OR was
+    # dropped by the tail — but at least one bucket carries an exemplar
+    # from THIS run's ids
+    ex = telemetry.histogram(
+        "serving.frontend.request_latency_seconds").exemplars()
+    assert ex, "no latency bucket carries an exemplar"
+    ids = {c.trace_id for c in ctxs}
+    assert any(tid in ids for tid, _, _ in ex.values())
+
+
+@pytest.mark.needs_f64
+def test_solo_retry_keeps_original_trace_id(traced_frontend):
+    """Fault isolation re-scores a poisoned window per-request: each
+    retried request must keep its ORIGINAL context (same trace_id, one
+    timeline) with the retry_solo hop recorded."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.game_data import GameDataset
+
+    fe, gm = traced_frontend
+    good = _singles(600, 4)
+    bad = GameDataset.build(
+        responses=np.zeros(1),
+        feature_shards={"global": sp.csr_matrix(np.ones((1, 6)))},
+        ids={})  # missing 'user' shard and id columns
+    ctxs = [mint("request") for _ in range(5)]
+
+    async def run():
+        async with fe:
+            tasks = [asyncio.ensure_future(fe.score(r, trace=c))
+                     for r, c in zip(good[:2] + [bad] + good[2:], ctxs)]
+            return await asyncio.gather(*tasks, return_exceptions=True)
+
+    out = asyncio.run(run())
+    assert isinstance(out[2], KeyError)
+    assert fe.stats()["isolation_splits"] == 1
+    good_ctxs = ctxs[:2] + ctxs[3:]
+    for ctx in good_ctxs:
+        assert ctx.outcome == "ok"
+        stages = [s for s, _ in ctx.events]
+        assert "retry_solo" in stages and "admit" in stages
+    # the offender: SAME context object finished as error, tail-kept
+    bad_ctx = ctxs[2]
+    assert bad_ctx.outcome == "error"
+    assert bad_ctx.annotations["error"] == "KeyError"
+    found = trace_tail().find(bad_ctx.trace_id)
+    assert found is not None and found["outcome"] == "error"
+    assert "retry_solo" in [e["stage"] for e in found["events"]]
+
+
+@pytest.mark.needs_f64
+def test_shed_keeps_timeline_and_tags_rejection(traced_frontend):
+    """Every shed keeps its trace: the typed RequestRejected carries the
+    trace_id and /tracez resolves it."""
+    fe, _ = traced_frontend
+    fe.config = FrontendConfig(coalesce_window_s=0.2, max_pending=1)
+    reqs = _singles(700, 3)
+
+    async def run():
+        async with fe:
+            return await asyncio.gather(
+                *[fe.score(r) for r in reqs], return_exceptions=True)
+
+    out = asyncio.run(run())
+    sheds = [e for e in out if isinstance(e, RequestRejected)]
+    assert sheds, "max_pending=1 must shed concurrent submissions"
+    for e in sheds:
+        assert e.trace_id is not None
+        found = trace_tail().find(e.trace_id)
+        assert found is not None
+        assert found["outcome"] == "shed"
+        assert found["annotations"]["scope"] == "process"
+
+
+@pytest.mark.needs_f64
+def test_deferred_path_keeps_timelines_and_resolvable_exemplars(
+        traced_frontend):
+    """The default (no explicit trace=) hot path defers trace
+    materialization to the batched group settle: kept timelines still
+    carry admit -> coalesce -> dispatch -> settle, and every latency
+    exemplar stamped on the histogram RESOLVES against /tracez (ids
+    mint only for kept traces)."""
+    fe, _ = traced_frontend
+    reqs = _singles(900, 24)
+    _, info = fe.replay(reqs, concurrency=8)
+    assert info["shed"] == 0 and info["errors"] == 0
+    snap = trace_tail().snapshot()
+    assert snap["seen"] == len(reqs)
+    kept = snap["traces"]["slow"] + snap["traces"]["floor"]
+    assert kept, "tail kept nothing from a 24-request replay"
+    for tr in kept:
+        stages = [e["stage"] for e in tr["events"]]
+        assert stages[0] == "admit" and stages[-1] == "settle"
+        assert "coalesce" in stages and "dispatch" in stages
+        assert tr["start_unix"] is not None
+    ex = telemetry.histogram(
+        "serving.frontend.request_latency_seconds").exemplars()
+    assert ex, "no exemplar stamped"
+    for tid, _, _ in ex.values():
+        assert trace_tail().find(tid) is not None, \
+            "exemplar must resolve to a kept /tracez timeline"
+
+
+# -- /tracez + exemplars under concurrent mutation -------------------------
+
+def test_tracez_and_exemplars_under_concurrent_scrape(sampling):
+    """Scrape-during-load (the PR 9 exposition discipline): /metrics
+    (with exemplars) and /tracez stay well-formed while worker threads
+    hammer observations and trace finishes."""
+    h = telemetry.histogram("load.request_latency_seconds",
+                            exemplars=True)
+    stop = threading.Event()
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            ctx = mint("request")
+            ctx.event("admit")
+            v = float(rng.random() * 0.01)
+            h.observe(v, exemplar=ctx.trace_id)
+            ctx.finish("ok" if rng.random() > 0.1 else "error")
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(3)]
+    with ObservabilityServer(port=0) as srv:
+        for t in threads:
+            t.start()
+        try:
+            for i in range(20):
+                # Alternate plain 0.0.4 and negotiated OpenMetrics
+                # scrapes: exemplar syntax is ILLEGAL in 0.0.4, so the
+                # plain render must stay exemplar-free while the
+                # Accept-negotiated one carries them + '# EOF'.
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/metrics",
+                    headers=({"Accept": "application/openmetrics-text"}
+                             if i % 2 else {}))
+                resp = urllib.request.urlopen(req, timeout=5)
+                text = resp.read().decode()
+                if i % 2:
+                    assert resp.headers["Content-Type"].startswith(
+                        "application/openmetrics-text")
+                    assert text.endswith("# EOF\n")
+                else:
+                    assert " # {" not in text, \
+                        "exemplar leaked into a text-0.0.4 scrape"
+                fams = parse_prometheus(text)  # oracle: monotone + +Inf
+                tz = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/tracez",
+                    timeout=5).read())
+                # every kept trace is structurally complete
+                for ring in tz["traces"].values():
+                    for tr in ring:
+                        assert tr["trace_id"].startswith("t")
+                        assert tr["outcome"] in ("ok", "error")
+                        assert tr["duration_s"] >= 0
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+    fam = fams["load_request_latency_seconds"]
+    assert fam["exemplars"], "no exemplar rendered under load"
+    for sample, labels, ex in fam["exemplars"]:
+        assert sample == "load_request_latency_seconds_bucket"
+        assert "le" in labels
+        assert ex["labels"]["trace_id"].startswith("t")
+    tzsnap = trace_tail().snapshot()
+    assert tzsnap["seen"] > 0
+    assert tzsnap["kept"]["error"] > 0
+
+
+# -- divergence watchdog ---------------------------------------------------
+
+def test_check_solver_finite_passes_and_raises(sampling):
+    check_solver_finite("streaming-lbfgs", 3, 1.0, 0.5, None)  # no-op
+    ctx = mint("solve")
+    with pytest.raises(SolverDivergedError) as ei:
+        check_solver_finite("streaming-lbfgs", 7, float("nan"), 1.0, ctx)
+    e = ei.value
+    assert e.solver == "streaming-lbfgs" and e.iteration == 7
+    assert e.trace_id == ctx.trace_id
+    assert "diverged at outer iteration 7" in str(e)
+    # the solve's context finished as diverged and is tail-kept
+    found = trace_tail().find(ctx.trace_id)
+    assert found is not None and found["outcome"] == "diverged"
+    assert found["annotations"]["iteration"] == 7
+    with pytest.raises(SolverDivergedError):
+        check_solver_finite("streaming-tron", 1, 0.0, float("inf"))
+
+
+def test_watchdog_triggers_trace_tagged_flight_dump(tmp_path, rng):
+    """Driver-level: NaN training data diverges the streamed solve; the
+    typed SolverDivergedError triggers the fault flight dump, tagged
+    with the solve's trace_id, whose traces block holds the diverged
+    timeline (ISSUE 11 satellite acceptance)."""
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+
+    train = tmp_path / "train"
+    train.mkdir(parents=True)
+    records = []
+    for i in range(96):
+        vals = rng.normal(0, 1, 3)
+        records.append({
+            "uid": f"u{i}",
+            "label": float(i % 2),
+            "features": [
+                {"name": f"f{j}", "term": None,
+                 # poison one row: a NaN feature value NaNs the margins
+                 "value": (float("nan") if i == 17 and j == 0
+                           else float(v))}
+                for j, v in enumerate(vals)],
+            "weight": None, "offset": None, "metadataMap": None})
+    write_container(train / "part-00000.avro",
+                    schemas.TRAINING_EXAMPLE, records)
+    out = tmp_path / "diverged"
+    with pytest.raises(SolverDivergedError) as ei:
+        game_training_driver.run([
+            "--train-input-dirs", str(train),
+            "--output-dir", str(out),
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--fixed-effect-data-configurations", "fixed:global",
+            "--fixed-effect-optimization-configurations",
+            "fixed:10,1e-7,1.0,1.0,LBFGS,L2",
+            "--updating-sequence", "fixed",
+            "--stream-train", "--batch-rows", "32",
+            "--hbm-budget", "64M", "--feeder", "python",
+        ])
+    e = ei.value
+    assert e.solver == "streaming-lbfgs" and e.trace_id is not None
+    flight = json.loads((out / "flight.json").read_text())
+    fl = flight["flight"]
+    assert fl["reason"] == "fault:SolverDivergedError"
+    assert fl["trace_id"] == e.trace_id
+    # the diverged solve's timeline is stamped into the dump
+    errors = fl["traces"]["traces"]["error"]
+    diverged = [t for t in errors if t["trace_id"] == e.trace_id]
+    assert len(diverged) == 1
+    assert diverged[0]["outcome"] == "diverged"
+    assert diverged[0]["annotations"]["coordinate"] == "fixed"
+    assert diverged[0]["annotations"]["solver"] == "streaming-lbfgs"
+
+
+# -- executable profiler ---------------------------------------------------
+
+@pytest.mark.needs_f64
+def test_profiler_build_and_dispatch_table(traced_frontend):
+    """The cache profiler records per-key lower/first-call wall + cost
+    analysis at build and per-bucket dispatch-to-settle timings, and
+    the table rides in frontend stats (-> /statusz, metrics.json)."""
+    fe, _ = traced_frontend
+    reqs = _singles(800, 12)
+    results, info = fe.replay(reqs, concurrency=4)
+    assert info["errors"] == 0
+    table = fe.stats()["cache"]["profiler"]
+    assert table["builds"], "no build was profiled"
+    for entry in table["builds"].values():
+        assert entry["lower_s"] is not None and entry["lower_s"] > 0
+        assert entry["first_call_s"] is not None
+        # CPU backend reports static FLOPs for these kernels
+        assert entry.get("flops", 0) >= 0
+    assert table["dispatch"], "no dispatch was profiled"
+    for row in table["dispatch"].values():
+        assert row["dispatches"] >= 1
+        assert row["mean_s"] > 0
+        assert row["min_s"] <= row["mean_s"] <= row["max_s"]
+    # per-bucket registry histograms observed dispatches
+    snap = telemetry.snapshot()["histograms"]
+    bucket_hists = [k for k in snap
+                    if k.startswith("serving.bucket.r")
+                    and k.endswith(".dispatch_seconds")]
+    assert bucket_hists
+    assert sum(snap[k]["count"] for k in bucket_hists) \
+        == sum(r["dispatches"] for r in table["dispatch"].values())
+    # profiling did not defeat the compile-count discipline
+    fe.cache.assert_max_retraces(per_fn=1)
+    assert fe.cache.total_traces() == fe.cache.compilations
