@@ -2299,6 +2299,273 @@ def stream_training_bench():
     }
 
 
+def _mf_train_problem(full: bool):
+    """Cached MF Avro container (userId in metadataMap, linear labels
+    with per-entity low-rank structure) shared by the mf_training
+    parent and its per-mode child subprocesses."""
+    rows = int(os.environ.get("PHOTON_BENCH_MF_TRAIN_ROWS") or
+               (120_000 if full else 12_000))
+    d, per_row, k_true = 200, 8, 4
+    n_users = max(rows // 40, 8)
+    cache_dir = (os.environ.get("PHOTON_BENCH_INGEST_CACHE")
+                 or os.path.expanduser("~/.cache/photon_ingest_bench"))
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir,
+                        f"mf_train_v1_{rows}x{per_row}_d{d}"
+                        f"_u{n_users}.avro")
+    if not os.path.exists(path):
+        from photon_ml_tpu.io import schemas
+        from photon_ml_tpu.io.avro_codec import write_container
+
+        def records():
+            rng = np.random.default_rng(17)
+            b_true = rng.normal(0, 1, (k_true, d))
+            g_true = rng.normal(0, 1, (n_users, k_true))
+            coefs = g_true @ b_true
+            made = 0
+            while made < rows:
+                m = min(50_000, rows - made)
+                cols = (rng.integers(0, d // per_row, (m, per_row))
+                        * per_row + np.arange(per_row))
+                vals = rng.normal(0, 1, (m, per_row))
+                users = rng.integers(0, n_users, m)
+                for i in range(m):
+                    z = float(vals[i] @ coefs[users[i]][cols[i]])
+                    yield {
+                        "uid": None,
+                        "label": z + float(rng.normal(0, 0.05)),
+                        "features": [
+                            {"name": f"f{c}", "term": None,
+                             "value": float(v)}
+                            for c, v in zip(cols[i], vals[i])],
+                        "weight": None, "offset": None,
+                        "metadataMap": {"userId": f"u{users[i]}"}}
+                made += m
+
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            write_container(tmp, schemas.TRAINING_EXAMPLE, records())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return path, rows, d, n_users
+
+
+def _mf_train_child(cfg: dict) -> None:
+    """One mf_training measurement mode in an isolated process (peak
+    RSS is the MODE's peak). Prints one JSON line.
+
+    Modes: 'incore' (the FactoredRandomEffectCoordinate — dense entity
+    blocks, vmapped solves), 'resident'/'spill'/'spill_bf16'/
+    'spill_redecode' (the streamed ALS subsystem at increasing
+    out-of-core pressure). Each times the alternating sweeps end to end
+    and hashes the trained latent artifacts so the parent can assert
+    model-byte identity across residency configs."""
+    import hashlib
+
+    from photon_ml_tpu.data.avro_reader import build_index_map
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        MFOptimizationConfiguration,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.types import TaskType
+
+    mode = cfg["mode"]
+    path = cfg["path"]
+    rows = cfg["rows"]
+    sweeps = cfg.get("sweeps", 2)
+    k = cfg.get("num_factors", 8)
+    out = {"mode": mode}
+    l2 = RegularizationContext(RegularizationType.L2)
+    glm_cfg = GLMOptimizationConfiguration(
+        max_iterations=10, tolerance=1e-8, regularization_weight=1e-3,
+        regularization_context=l2)
+    mf_cfg = MFOptimizationConfiguration(max_iterations=sweeps,
+                                         num_factors=k)
+    imap = build_index_map(path)
+    maps = {"global": imap}
+
+    def model_sha(model):
+        h = hashlib.sha256()
+        for c in model.latent.local_coefs:
+            h.update(np.asarray(c).tobytes())
+        h.update(np.asarray(model.projection_matrix).tobytes())
+        return h.hexdigest()
+
+    if mode == "incore":
+        import jax
+
+        from photon_ml_tpu.algorithm import FactoredRandomEffectCoordinate
+        from photon_ml_tpu.data.avro_reader import read_game_dataset
+        from photon_ml_tpu.data.random_effect import (
+            RandomEffectDataConfiguration,
+            build_random_effect_dataset,
+        )
+
+        t0 = time.perf_counter()
+        data, _ = read_game_dataset(path, id_types=["userId"],
+                                    feature_shard_maps=maps)
+        ds = build_random_effect_dataset(
+            data, RandomEffectDataConfiguration(
+                "userId", "global", projector_type="IDENTITY"),
+            seed=0)
+        setup_dt = time.perf_counter() - t0
+        coord = FactoredRandomEffectCoordinate(
+            name="mf", dataset=ds, task_type=TaskType.LINEAR_REGRESSION,
+            config=glm_cfg, latent_config=glm_cfg, mf_config=mf_cfg,
+            seed=0)
+        t0 = time.perf_counter()
+        model, _ = coord.update_model(coord.initialize_model(), None,
+                                      jax.random.key(0))
+        jax.block_until_ready(model.latent.local_coefs)
+        solve_dt = time.perf_counter() - t0
+        out.update({
+            "setup_seconds": round(setup_dt, 3),
+            "sweep_rows_per_sec": round(rows * sweeps / solve_dt),
+            "model_sha256": model_sha(model),
+        })
+    else:
+        from photon_ml_tpu.algorithm.coordinates import (
+            StreamingFactoredRandomEffectCoordinate,
+        )
+        from photon_ml_tpu.data.block_stream import (
+            BlockGameStream,
+            BlockRandomAccess,
+        )
+
+        budget = None if mode == "resident" else cfg["hbm_budget_bytes"]
+        spill_dtype = "bf16" if mode == "spill_bf16" else "f32"
+        spill_source = ("redecode" if mode == "spill_redecode"
+                        else "buffer")
+        fetcher = None
+        if spill_source == "redecode":
+            fetcher = BlockRandomAccess(path, id_types=["userId"],
+                                        feature_shard_maps=maps)
+
+        def stream():
+            return BlockGameStream(
+                path, id_types=["userId"], feature_shard_maps=maps,
+                batch_rows=cfg["batch_rows"], prefetch_depth=2)
+
+        t0 = time.perf_counter()
+        coord = StreamingFactoredRandomEffectCoordinate(
+            name="mf", make_stream=stream, feature_shard_id="global",
+            random_effect_type="userId",
+            task_type=TaskType.LINEAR_REGRESSION,
+            config=glm_cfg, latent_config=glm_cfg, mf_config=mf_cfg,
+            seed=0, hbm_budget_bytes=budget, spill_dtype=spill_dtype,
+            spill_source=spill_source, random_access=fetcher)
+        setup_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        model, _ = coord.solve()
+        solve_dt = time.perf_counter() - t0
+        coord.mf_objective.assert_trace_budget()
+        out.update({
+            "setup_seconds": round(setup_dt, 3),
+            "sweep_rows_per_sec": round(rows * sweeps / solve_dt),
+            "model_sha256": model_sha(model),
+            "cache": coord.cache.stats(),
+            "trace_counts": coord.mf_objective.guard.counts(),
+            "trace_budgets": coord.mf_objective.trace_budgets(),
+            "compile_bound_ok": True,  # assert_trace_budget passed
+        })
+    out["peak_rss_mb"] = _peak_rss_mb()
+    print(json.dumps(out))
+
+
+def mf_training_bench():
+    """Out-of-core MF training (the ALX-style factor-cache tentpole):
+    in-core FactoredRandomEffectCoordinate vs streamed-resident vs the
+    spill tiers, each in its own subprocess so peak host RSS is
+    per-mode truth. The streamed f32 tiers (resident / buffer spill /
+    redecode) must hash to IDENTICAL latent model bytes — residency is
+    invisible in the bits — and compile counts stay bucket-bounded
+    (TracingGuard-asserted in each child). On this host all stages
+    share cpu_cores core(s), so rates are honest single-core numbers;
+    the streamed path exists for factor tables HBM cannot hold, not for
+    single-core speed."""
+    full = SHAPE_SCALE == "full"
+    path, rows, d, n_users = _mf_train_problem(full)
+    batch_rows = 8_192 if full else 2_048
+    k = 8
+    # Budget ~40% of the padded factor-table bytes: steady eviction
+    # with several shards resident.
+    approx_factor_bytes = 4 * k * n_users
+    budget = max(1, int(0.4 * approx_factor_bytes))
+    try:
+        cpu_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cpu_cores = os.cpu_count() or 1
+
+    results = {}
+    for mode in ("incore", "resident", "spill", "spill_bf16",
+                 "spill_redecode"):
+        cfg = {"mode": mode, "path": path, "rows": rows,
+               "batch_rows": batch_rows, "hbm_budget_bytes": budget,
+               "num_factors": k}
+        env = dict(os.environ,
+                   PHOTON_BENCH_MF_TRAIN_CHILD=json.dumps(cfg))
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=3600, check=True)
+        results[mode] = json.loads(out.stdout.strip().splitlines()[-1])
+
+    incore, resident, spill = (results["incore"], results["resident"],
+                               results["spill"])
+    bf16, redecode = results["spill_bf16"], results["spill_redecode"]
+    f32_hashes = {resident["model_sha256"], spill["model_sha256"],
+                  redecode["model_sha256"]}
+    return {
+        "incore": incore,
+        "stream_resident": resident,
+        "stream_spill": spill,
+        "stream_spill_bf16": bf16,
+        "stream_spill_redecode": redecode,
+        # The tentpole acceptance, asserted on real bytes: every f32
+        # residency/spill config writes the same latent model.
+        "identical_model_across_residency": len(f32_hashes) == 1,
+        "bf16_model_differs_as_documented":
+            bf16["model_sha256"] not in f32_hashes,
+        "compile_bound_ok": all(
+            results[m]["compile_bound_ok"]
+            for m in ("resident", "spill", "spill_bf16",
+                      "spill_redecode")),
+        "redecode_spill_bytes_host":
+            redecode["cache"]["spill_bytes_host"],
+        "spill_evictions": spill["cache"]["evictions"],
+        "stream_vs_incore_sweep_ratio": round(
+            resident["sweep_rows_per_sec"]
+            / max(1, incore["sweep_rows_per_sec"]), 3),
+        "spill_vs_resident_sweep_ratio": round(
+            spill["sweep_rows_per_sec"]
+            / max(1, resident["sweep_rows_per_sec"]), 3),
+        "spill_vs_incore_rss_ratio": round(
+            spill["peak_rss_mb"] / max(1e-9, incore["peak_rss_mb"]), 3),
+        "hbm_budget_bytes": budget,
+        "batch_rows": batch_rows,
+        "rows": rows,
+        "entities": n_users,
+        "num_factors": k,
+        "cpu_cores": cpu_cores,
+        "shape": f"{rows} rows, {n_users} entities, d={d}, k={k}, "
+                 "linear labels w/ rank-4 truth, TrainingExampleAvro",
+        "note": "per-mode subprocesses: peak_rss_mb is each mode's own "
+                "peak. The streamed path re-decodes observations every "
+                "feature pass (2/LBFGS-iteration + 1 gamma pass per "
+                "sweep) — on this 1-core host that decode shares the "
+                "solver's core, so sweep rates are the honest "
+                "out-of-core price vs the in-core coordinate's "
+                "dense-resident blocks; no speed win is claimed. The "
+                "measured claims: identical latent bytes across every "
+                "f32 residency config, zero host spill bytes in the "
+                "redecode tier, and per-bucket compile bounds at every "
+                "tier (TracingGuard-asserted in each child)",
+    }
+
+
 def aot_fe_cost_analysis():
     """Compiler-derived v5e cost model for the fixed-effect L-BFGS solve
     (deviceless AOT against an abstract v5e topology — works with no
@@ -2500,6 +2767,12 @@ def main():
         # its peak RSS is its own (see stream_training_bench).
         _stream_train_child(json.loads(child_cfg))
         return
+    mf_child_cfg = os.environ.get("PHOTON_BENCH_MF_TRAIN_CHILD")
+    if mf_child_cfg:
+        # Subprocess mode: one mf_training measurement (see
+        # mf_training_bench) — same per-mode RSS isolation.
+        _mf_train_child(json.loads(mf_child_cfg))
+        return
     if os.environ.get("PHOTON_BENCH_CPU_BASELINE") == "1":
         # Subprocess mode: measure the CPU baseline (1 iteration). The env
         # var alone can be overridden by platform sitecustomize hooks —
@@ -2656,6 +2929,7 @@ def main():
     observability = _try(observability_bench, {"note": "failed"})
     stream_scoring = _try(stream_scoring_bench, {"note": "failed"})
     stream_training = _try(stream_training_bench, {"note": "failed"})
+    mf_training = _try(mf_training_bench, {"note": "failed"})
     # On a real chip run the live libtpu client holds the process lock
     # the compile-only topology client needs — and chip timings
     # supersede the compile-only cost model anyway, so the extra is
@@ -2774,6 +3048,7 @@ def main():
             "observability": observability,
             "stream_scoring": stream_scoring,
             "stream_training": stream_training,
+            "mf_training": mf_training,
             "aot_v5e_cost": aot_cost,
             "shape_scale": SHAPE_SCALE,
             "vs_baseline_note": "amortized-10it rate vs the amortized "

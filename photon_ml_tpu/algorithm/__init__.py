@@ -5,6 +5,7 @@ from photon_ml_tpu.algorithm.coordinates import (
     FactoredRandomEffectCoordinate,
     FixedEffectCoordinate,
     RandomEffectCoordinate,
+    StreamingFactoredRandomEffectCoordinate,
     StreamingFixedEffectCoordinate,
 )
 from photon_ml_tpu.algorithm.coordinate_descent import CoordinateDescent
@@ -14,6 +15,7 @@ __all__ = [
     "FactoredRandomEffectCoordinate",
     "FixedEffectCoordinate",
     "RandomEffectCoordinate",
+    "StreamingFactoredRandomEffectCoordinate",
     "StreamingFixedEffectCoordinate",
     "CoordinateDescent",
 ]

@@ -606,6 +606,245 @@ def _shard_re_dataset(dataset: RandomEffectDataset, mesh
 
 
 @dataclasses.dataclass
+class StreamingFactoredRandomEffectCoordinate:
+    """Out-of-core factored random effect (matrix factorization) — the
+    streamed/sharded counterpart of :class:`FactoredRandomEffectCoordinate`,
+    built on `ops/mf_alternating.py` + `data/factor_cache.py` (PAPERS.md
+    "ALX: Large Scale Matrix Factorization on TPUs"): factor tables live
+    in a budgeted `DeviceFactorCache` (pow-2 observation-count bucketing,
+    replay-aware eviction, f32/bf16/redecode spill tiers), observations
+    stream through `BlockGameStream` batches re-decoded per feature pass,
+    the per-entity gamma half-step is an exact streamed ridge ALS
+    (batched per-bucket normal-equation solves), and the projection
+    refit reuses `minimize_lbfgs_glm_streaming` over the duck-typed
+    Kronecker-margin objective. Factor tables larger than
+    ``hbm_budget_bytes`` train to completion out-of-core.
+
+    Scope (enforced): LINEAR_REGRESSION (squared loss — the alternating
+    half-steps are least squares; other GLM losses alternate IRLS
+    in-core), L2-only with a strictly positive gamma ridge (λ₂ = 0
+    normal equations are singular for low-observation entities), no
+    down-sampling, L-BFGS latent refits. Everything else trains through
+    the in-core coordinate.
+
+    Plugs into coordinate descent behind the existing residual-fitting
+    contract: ``solve(model, residual_scores=...)`` folds the other
+    coordinates' scores into the streamed offsets, and ``score(model)``
+    returns raw margins γᵀ B x. Each alternating sweep runs under its
+    own minted `TraceContext` (kind ``mf_sweep`` — slow sweeps land on
+    /tracez) and the per-sweep objective is checked by
+    `check_solver_finite`, so a NaN/Inf alternating solve raises a typed
+    :class:`~photon_ml_tpu.optimization.convergence.SolverDivergedError`
+    with a trace-tagged flight dump, like the streamed L-BFGS/TRON
+    paths.
+
+    ``mf_objective`` shares the built `StreamedMFObjective` (plan +
+    factor cache + compiled kernels) across λ-grid points with the same
+    ``num_factors`` — the same no-recompile sharing contract as
+    `StreamingFixedEffectCoordinate.sharded_objective`.
+    """
+
+    name: str
+    make_stream: object  # () -> iterable of GameDataset batches
+    feature_shard_id: str
+    random_effect_type: str
+    task_type: TaskType
+    config: GLMOptimizationConfiguration  # per-entity gamma ridge
+    latent_config: GLMOptimizationConfiguration  # projection refit
+    mf_config: "MFOptimizationConfiguration"
+    n_features: Optional[int] = None  # settled by the planning pass
+    hbm_budget_bytes: Optional[int] = None
+    spill_dtype: str = "f32"
+    spill_source: str = "buffer"
+    entities_per_shard: int = 512
+    seed: int = 7
+    tracing_guard: Optional[object] = None
+    mf_objective: Optional[object] = None  # shared StreamedMFObjective
+    random_access: Optional[object] = None  # BlockRandomAccess hook
+
+    def __post_init__(self):
+        from photon_ml_tpu.optimization.config import OptimizerType
+
+        if self.task_type != TaskType.LINEAR_REGRESSION:
+            raise ValueError(
+                "streamed MF alternating least squares is defined for "
+                "LINEAR_REGRESSION (squared loss); other tasks train "
+                "through the in-core FactoredRandomEffectCoordinate")
+        l1, l2 = _l1_l2(self.config)
+        ll1, self._ll2 = _l1_l2(self.latent_config)
+        if l1 > 0 or ll1 > 0:
+            raise ValueError(
+                "streamed MF supports L2 only; L1/elastic-net factors "
+                "need the in-core path")
+        if l2 <= 0:
+            raise ValueError(
+                "streamed MF needs a strictly positive gamma L2 weight "
+                "(the per-entity ridge normal equations are singular at "
+                "λ₂ = 0 for low-observation entities)")
+        if self.config.down_sampling_rate < 1.0 \
+                or self.latent_config.down_sampling_rate < 1.0:
+            raise ValueError(
+                "down-sampling is not supported with streamed MF "
+                "solves; use the in-core path")
+        if self.latent_config.optimizer_type != OptimizerType.LBFGS:
+            raise ValueError(
+                f"streamed MF latent refits support LBFGS, got "
+                f"{self.latent_config.optimizer_type}")
+        self._l2 = l2
+        k = self.mf_config.num_factors
+        if self.mf_objective is not None:
+            if self.mf_objective.k != k:
+                raise ValueError(
+                    f"shared mf_objective was built for num_factors="
+                    f"{self.mf_objective.k}, coordinate asks for {k}")
+            self._obj = self.mf_objective
+        else:
+            from photon_ml_tpu.data.factor_cache import (
+                DeviceFactorCache,
+                count_stream_entities,
+                plan_factors,
+            )
+            from photon_ml_tpu.ops.mf_alternating import (
+                StreamedMFObjective,
+            )
+
+            with _telemetry_span("factor_plan"):
+                vocab, counts, n_rows, d_by_shard = count_stream_entities(
+                    self.make_stream(), self.random_effect_type)
+            if self.feature_shard_id not in d_by_shard:
+                raise KeyError(
+                    f"stream carries no feature shard "
+                    f"{self.feature_shard_id!r} "
+                    f"(have {sorted(d_by_shard)})")
+            d = d_by_shard[self.feature_shard_id]
+            if self.n_features is not None and self.n_features != d:
+                raise ValueError(
+                    f"stream decodes {d} features for shard "
+                    f"{self.feature_shard_id!r}, coordinate expected "
+                    f"{self.n_features}")
+            self.n_features = d
+            plan = plan_factors(vocab, counts,
+                                entities_per_shard=self.entities_per_shard)
+            cache = DeviceFactorCache(
+                plan, k, hbm_budget_bytes=self.hbm_budget_bytes,
+                spill_dtype=self.spill_dtype,
+                spill_source=self.spill_source)
+            self._obj = StreamedMFObjective(
+                self.make_stream, self.feature_shard_id,
+                self.random_effect_type, plan, cache, d,
+                loss_for_task(self.task_type),
+                tracing_guard=self.tracing_guard,
+                random_access=self.random_access)
+            self._obj.n_rows = n_rows
+            self.mf_objective = self._obj
+        self.n_features = self._obj.d
+
+    @property
+    def cache(self):
+        """The factor cache (live /statusz residency provider)."""
+        return self._obj.cache
+
+    @property
+    def plan(self):
+        return self._obj.plan
+
+    def initialize_model(self):
+        """Zero factors + the SAME seeded Gaussian projection init as
+        the in-core coordinate, so streamed-vs-in-core parity starts
+        from identical B₀."""
+        from photon_ml_tpu.models.factored_random_effect import (
+            FactoredRandomEffectModel,
+        )
+        from photon_ml_tpu.projector.projectors import ProjectionMatrix
+
+        k = self.mf_config.num_factors
+        d = self.n_features
+        plan = self._obj.plan
+        b0 = ProjectionMatrix.gaussian(k, d, intercept_col=None,
+                                       seed=self.seed)
+        latent = RandomEffectModel(
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id,
+            local_coefs=[jnp.zeros((s.n_entities, k), jnp.float32)
+                         for s in plan.shards],
+            feat_idx=[jnp.tile(jnp.arange(k), (s.n_entities, 1))
+                      for s in plan.shards],
+            entity_codes=[s.codes.astype(np.int32) for s in plan.shards],
+            vocabulary=plan.vocabulary,
+            num_global_features=d,
+            projection=b0,
+        )
+        return FactoredRandomEffectModel(latent, self.mf_config)
+
+    def solve(self, model=None, residual_scores=None, trace_ctx=None):
+        """``mf_config.max_iterations`` alternating sweeps (streamed
+        ridge gamma pass + streamed L-BFGS projection refit), warm-
+        starting B from ``model``. Returns ``(model, trackers)`` with
+        one OptimizerResult per sweep — the in-core coordinate's
+        tracker shape."""
+        from photon_ml_tpu import telemetry
+        from photon_ml_tpu.optimization.glm_lbfgs import (
+            minimize_lbfgs_glm_streaming,
+        )
+        from photon_ml_tpu.optimization.convergence import (
+            check_solver_finite,
+        )
+
+        if model is None:
+            model = self.initialize_model()
+        b_mat = jnp.asarray(model.projection_matrix, jnp.float32)
+        self._obj.set_residual(residual_scores)
+        trackers = []
+        for sweep in range(self.mf_config.max_iterations):
+            # One trace context per alternating sweep: slow sweeps land
+            # on /tracez, and a divergence fault carries the sweep's
+            # trace_id into the flight dump (PR-11 watchdog parity).
+            ctx = telemetry.mint("mf_sweep")
+            ctx.annotate(coordinate=self.name, sweep=sweep,
+                         num_factors=self.mf_config.num_factors,
+                         reg_weight=self.config.regularization_weight)
+            if trace_ctx is not None:
+                trace_ctx.event("mf_sweep")
+            ctx.event("gamma_pass")
+            self._obj.gamma_pass(b_mat, self._l2)
+            ctx.event("latent_refit")
+            result = minimize_lbfgs_glm_streaming(
+                self._obj, jnp.reshape(b_mat, (-1,)), self._ll2,
+                max_iter=self.latent_config.max_iterations,
+                tol=self.latent_config.tolerance, trace_ctx=ctx)
+            b_mat = jnp.reshape(result.x, b_mat.shape)
+            # Per-sweep watchdog: the refit's own iterations are already
+            # host-checked inside the streamed L-BFGS; re-assert on the
+            # sweep boundary so a NaN that rode the FACTOR tables into
+            # the refit fails fast under the MF label.
+            check_solver_finite(
+                "streaming-mf-alternating", sweep,
+                np.asarray(result.value)[()],
+                np.asarray(result.grad_norm)[()], ctx)
+            ctx.finish("ok")
+            trackers.append(result)
+        self._obj.assert_trace_budget()
+        tables = self._obj.factor_tables()
+        return model.with_update(list(tables), np.asarray(b_mat)), trackers
+
+    def score(self, model) -> Array:
+        """Raw margins γᵀ B x per global row (offsets excluded, like
+        every coordinate score) — one streamed pass over the
+        observations. Scores the MODEL's factor tables, not the
+        objective's internal solve state (a later λ-grid point sharing
+        the objective may have overwritten it)."""
+        return jnp.asarray(self._obj.score_pass(
+            np.asarray(model.projection_matrix, np.float32),
+            tables=model.latent.local_coefs))
+
+
+def _telemetry_span(stage: str):
+    from photon_ml_tpu.telemetry import span
+
+    return span(stage)
+
+
+@dataclasses.dataclass
 class FactoredRandomEffectCoordinate(Coordinate):
     """Matrix-factorization-flavored random effect
     (ml/algorithm/FactoredRandomEffectCoordinate.scala:39-289).

@@ -384,19 +384,32 @@ def _run_training(args, logger, task, emitter, obs):
             "would silently train as f32; pick one")
 
     if args.stream_train:
-        if re_data or fre_data or len(sequence) != 1 \
-                or sequence[0] not in fe_data:
+        if re_data or len(sequence) != 1 \
+                or (sequence[0] not in fe_data
+                    and sequence[0] not in fre_data):
             raise ValueError(
                 "--stream-train supports exactly one fixed-effect "
-                "coordinate (random/factored effects need entity "
-                f"grouping over the full dataset); got sequence "
-                f"{sequence}")
+                "or factored-random-effect coordinate (plain random "
+                "effects need entity grouping over the full dataset); "
+                f"got sequence {sequence}")
+        if sequence[0] in fre_data and args.mesh_devices is not None:
+            raise ValueError(
+                "--mesh-devices is not supported for streamed MF "
+                "coordinates yet (the factor-table device fold is the "
+                "noted follow-on); drop the flag")
         with maybe_trace(args.profile_output_dir):
-            (results, best_configs, best_result, shard_maps, num_rows,
-             stream_info) = _stream_train(
-                args, logger, task, fe_data, fe_opt, sequence,
-                train_inputs, evaluators, preloaded_maps, opt_grid,
-                emitter, obs)
+            if sequence[0] in fre_data:
+                (results, best_configs, best_result, shard_maps,
+                 num_rows, stream_info) = _stream_train_mf(
+                    args, logger, task, fre_data, fre_opt, sequence,
+                    train_inputs, evaluators, preloaded_maps, emitter,
+                    obs)
+            else:
+                (results, best_configs, best_result, shard_maps,
+                 num_rows, stream_info) = _stream_train(
+                    args, logger, task, fe_data, fe_opt, sequence,
+                    train_inputs, evaluators, preloaded_maps, opt_grid,
+                    emitter, obs)
         return (sequence, results, best_configs, best_result, shard_maps,
                 num_rows, stream_info)
 
@@ -794,6 +807,216 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
         last = trk[-1] if trk else None
         emitter.send_event(PhotonOptimizationLogEvent(
             reg_weight=cfg.regularization_weight,
+            iterations=(int(last.iterations) if last is not None else 0),
+            converged_reason=(last.reason_enum().summary
+                              if last is not None else "unknown"),
+            final_value=(float(last.value) if last is not None
+                         else float("nan")),
+            metrics=(res.validation_history[-1]
+                     if res.validation_history else None)))
+
+    from photon_ml_tpu.estimators.game_estimator import select_best_result
+
+    best_configs, best_result = select_best_result(results, evaluators)
+    return (results, best_configs, best_result, shard_maps, num_rows,
+            stream_info)
+
+
+def _stream_train_mf(args, logger, task, fre_data, fre_opt, sequence,
+                     train_inputs, evaluators, preloaded_maps, emitter,
+                     obs):
+    """Out-of-core MATRIX FACTORIZATION training (--stream-train with a
+    factored-random-effect coordinate): observations stream through
+    `BlockGameStream` (re-decoded per feature pass, host O(one block));
+    factor tables live in a budgeted `DeviceFactorCache` (ALX-style
+    pow-2 observation-count bucketing, replay-aware eviction, the PR-10
+    f32/bf16/redecode spill tiers) so factor tables larger than
+    ``--hbm-budget`` train to completion; alternating sweeps run the
+    streamed ridge gamma pass + streamed L-BFGS projection refit
+    (algorithm/coordinates.py StreamingFactoredRandomEffectCoordinate).
+    λ-grid points with the same num_factors share one compiled
+    objective, so the grid sweep never recompiles. The factor cache's
+    residency stats register as a live /statusz provider."""
+    import time as _time
+
+    from photon_ml_tpu.algorithm.coordinate_descent import (
+        CoordinateDescentResult,
+    )
+    from photon_ml_tpu.algorithm.coordinates import (
+        StreamingFactoredRandomEffectCoordinate,
+    )
+    from photon_ml_tpu.data.avro_reader import build_index_map
+    from photon_ml_tpu.data.block_stream import BlockGameStream
+    from photon_ml_tpu.models.game_model import GameModel
+
+    name = sequence[0]
+    data_cfg = fre_data[name]
+    shard = data_cfg.feature_shard_id
+    re_type = data_cfg.random_effect_type
+    if name not in fre_opt:
+        raise ValueError(
+            f"coordinate {name!r} has no optimization configuration — "
+            "pass it via "
+            "--factored-random-effect-optimization-configurations")
+    grid = [FactoredRandomEffectOptimizationConfiguration.parse(part)
+            for part in fre_opt[name].split("|")]
+
+    if preloaded_maps is not None:
+        if shard not in preloaded_maps:
+            raise ValueError(
+                f"factored coordinate {name!r} references unknown "
+                f"feature shard {shard!r} "
+                f"(have {sorted(preloaded_maps)})")
+        shard_maps = {shard: preloaded_maps[shard]}
+    else:
+        logger.info("building feature index for shard %r from %s",
+                    shard, train_inputs)
+        with span("build_index"):
+            shard_maps = {shard: build_index_map(
+                train_inputs, ingest_workers=args.ingest_workers)}
+
+    stream_holder = {}
+
+    def make_stream():
+        s = BlockGameStream(
+            train_inputs, id_types=[re_type],
+            feature_shard_maps=shard_maps,
+            batch_rows=args.batch_rows, feeder=args.feeder,
+            prefetch_depth=max(0, args.prefetch_batches))
+        stream_holder["last"] = s
+        return s
+
+    budget = args.hbm_budget
+    if args.checkpoint_dir:
+        logger.warning("--checkpoint-dir is not supported with "
+                       "--stream-train MF coordinates; ignoring")
+    fetcher = None
+    if budget is not None and args.spill_source == "redecode":
+        from photon_ml_tpu.data.block_stream import BlockRandomAccess
+
+        # Factor-shard misses re-derive from observations: the hook
+        # re-decodes ONLY the covering container batches by global row
+        # range (the PR-10 out-of-core miss path, re-pointed at the
+        # factor tables' normal equations).
+        fetcher = BlockRandomAccess(
+            train_inputs, id_types=[re_type],
+            feature_shard_maps=shard_maps, feeder=args.feeder)
+    logger.info(
+        "stream-train (mf%s): %r over %r entities from %s in %d-row "
+        "batches", "" if budget is None else
+        f", hbm budget {budget} bytes, spill {args.spill_dtype}/"
+        f"{args.spill_source}", name, re_type, train_inputs,
+        args.batch_rows)
+
+    shared = {}  # num_factors -> StreamedMFObjective (kernel sharing)
+    results = []
+
+    def _factor_cache_status():
+        # Live residency view, mirroring the shard-cache provider of
+        # the fixed-effect spill path. Reads THROUGH the shared-
+        # objective table so a grid spanning several num_factors values
+        # (several caches) stays fully observable — single-k grids keep
+        # the flat shard-cache-style schema.
+        if len(shared) == 1:
+            return next(iter(shared.values())).cache.stats()
+        return {f"num_factors_{k}": o.cache.stats()
+                for k, o in sorted(shared.items())}
+
+    with span("solve"):
+        for cfg in grid:
+            coord = StreamingFactoredRandomEffectCoordinate(
+                name=name, make_stream=make_stream,
+                feature_shard_id=shard, random_effect_type=re_type,
+                task_type=task, config=cfg.random_effect,
+                latent_config=cfg.latent_factor, mf_config=cfg.mf,
+                # seed 0 = GameEstimator.fit's default, so the streamed
+                # B0 matches what the in-core driver path initializes
+                # (parity tests compare the two end to end).
+                seed=0,
+                hbm_budget_bytes=budget,
+                spill_dtype=(args.spill_dtype if budget is not None
+                             else "f32"),
+                spill_source=(args.spill_source if budget is not None
+                              else "buffer"),
+                mf_objective=shared.get(cfg.mf.num_factors),
+                random_access=fetcher)
+            if not shared:
+                obs.add_status_provider("factor_cache",
+                                        _factor_cache_status)
+            shared[cfg.mf.num_factors] = coord.mf_objective
+            t0 = _time.perf_counter()
+            model, trackers, obj_hist = None, [], []
+            ctx = telemetry.mint("solve")
+            ctx.annotate(coordinate=name,
+                         reg_weight=cfg.random_effect.regularization_weight,
+                         num_factors=cfg.mf.num_factors,
+                         mf_sweeps=cfg.mf.max_iterations)
+            for _ in range(args.num_iterations):
+                model, sweep_trackers = coord.solve(model, trace_ctx=ctx)
+                trackers.extend(sweep_trackers)
+                obj_hist.append(float(sweep_trackers[-1].value))
+            ctx.annotate(
+                iterations=int(trackers[-1].iterations),
+                reason=trackers[-1].reason_enum().summary)
+            ctx.finish("ok")
+            gm = GameModel({name: model}, task)
+            results.append(({name: cfg}, CoordinateDescentResult(
+                model=gm, objective_history=obj_hist,
+                validation_history=[], best_model=gm,
+                best_metric=None, trackers={name: trackers},
+                timings={name: _time.perf_counter() - t0})))
+
+    first_obj = next(iter(shared.values()))
+    num_rows = first_obj.n_rows
+    stream_info = {
+        "mode": "mf-stream",
+        "batch_rows": args.batch_rows,
+        "hbm_budget_bytes": budget,
+        "mesh_devices": None,  # factor-table device fold: follow-on
+        "spill_dtype": args.spill_dtype if budget is not None else None,
+        "spill_source": (args.spill_source if budget is not None
+                         else None),
+        "feeder": (stream_holder["last"].stats()
+                   if "last" in stream_holder else None),
+        "cache": first_obj.cache.stats(),
+        "plan": {
+            "entities": first_obj.plan.num_entities,
+            "shards": first_obj.plan.n_shards,
+            "obs_bucket_histogram": {
+                str(k): v for k, v in sorted(
+                    first_obj.plan.obs_bucket_histogram().items())},
+        },
+        "trace_budgets": first_obj.trace_budgets(),
+        "trace_counts": first_obj.guard.counts(),
+    }
+    if len(shared) > 1:
+        # A grid spanning several num_factors values trains several
+        # factor caches; the flat "cache" block above covers the first
+        # — report the rest too so none is invisible post-run.
+        stream_info["cache_by_num_factors"] = {
+            str(k): o.cache.stats() for k, o in sorted(shared.items())}
+    if fetcher is not None:
+        stream_info["redecode"] = {
+            "decode_path": fetcher.decode_path,
+            "payload_bytes_read": fetcher.payload_bytes_read,
+            "blocks_decoded": fetcher.blocks_decoded,
+            "rows_fetched": fetcher.rows_fetched,
+        }
+
+    if args.validate_input_dirs and evaluators:
+        with span("validate"):
+            all_metrics = _stream_validate_many(
+                [res.model for _, res in results], args, shard_maps,
+                evaluators, logger)
+        for (_, res), metrics in zip(results, all_metrics):
+            res.validation_history.append(metrics)
+
+    for configs, res in results:
+        cfg = configs[name]
+        trk = list(res.trackers.get(name) or [])
+        last = trk[-1] if trk else None
+        emitter.send_event(PhotonOptimizationLogEvent(
+            reg_weight=cfg.random_effect.regularization_weight,
             iterations=(int(last.iterations) if last is not None else 0),
             converged_reason=(last.reason_enum().summary
                               if last is not None else "unknown"),

@@ -1,0 +1,552 @@
+"""Device-resident factor-table cache for out-of-core MATRIX FACTORIZATION.
+
+The GAME MF coordinate (`FactoredRandomEffectCoordinate`) materializes
+both factor tables densely and solves fully in-core, capping the MF leg
+at HBM while fixed and random effects already train out-of-core (PRs
+5/7/10). This module is the factor-side half of the streamed MF
+subsystem (ops/mf_alternating.py is the solver half): per-entity latent
+factor shards held in a `DeviceShardCache`-style cache so factor tables
+larger than HBM train to completion.
+
+**ALX-style planning** (`plan_factors`, PAPERS.md "ALX: Large Scale
+Matrix Factorization on TPUs"): entities are bucketed by OBSERVATION
+COUNT into power-of-two density classes — ALX's density-based bucketing,
+which groups entities whose per-entity solves have similar work so a
+batched shard wastes no padding on wildly mixed densities — then each
+class is cut into shards of at most ``entities_per_shard`` entities,
+padded to a pow-2 entity axis (``e_pad``). The resulting shard list is a
+pure function of (vocabulary, counts), so the fixed shard order — the
+replay order of every alternating sweep — is deterministic.
+
+**Residency** (`DeviceFactorCache`): each shard's gamma table
+(``f32[e_pad, k]``) is the evictable unit. ``hbm_budget_bytes`` bounds
+the factor bytes resident on device; eviction is replay-aware over the
+fixed alternating-sweep order (the sweep writes shards 0..n-1 in the
+gamma pass and reads them 0..n-1 at model assembly — a cyclic scan, so
+the victim is the resident shard whose next use is furthest in the
+cyclic order, exactly the Belady-on-cyclic-replay rule
+`DeviceShardCache` proved out; plain LRU is a guaranteed thrash on
+cyclic replay).
+
+**Spill tiers** — the PR-10 hierarchy, re-pointed at factors:
+
+- ``spill_dtype="f32"`` (default): evicted gamma tables spill to raw
+  f32 host buffers; restore re-uploads the evicted bytes verbatim, so
+  every replay/residency bitwise guarantee holds unchanged.
+- ``spill_dtype="bf16"``: factors are quantized to bfloat16 AT WRITE —
+  every shard takes the same bf16 round trip whether or not it ever
+  spills, so a bf16 train is deterministic AND residency-independent
+  (eviction history cannot touch the model bits); evicted shards spill
+  the bf16 bytes (half of f32) and restore widens back to f32 on
+  device, keeping the solver kernels' dtype contract untouched.
+- ``spill_source="redecode"``: NO host copy — an evicted shard is
+  dropped, and a cache miss re-derives it FROM OBSERVATIONS through the
+  ``redecode`` hook (ops/mf_alternating.py re-runs the shard's batched
+  normal-equation solve against the sweep's projection matrix over the
+  re-decoded covering observation batches). Because the per-sweep gamma
+  solve is an exact ridge solve — a pure function of (observations, B)
+  with no warm start — the re-derived bytes are bit-for-bit the evicted
+  ones. bf16 + redecode is rejected, exactly like the feature cache
+  (the combination would silently train f32 while reporting bf16).
+
+The reference's analog is the per-iteration factor RDD join of
+FactoredRandomEffectCoordinate.scala; ALX instead shards the embedding
+tables across chips — here the shard axis is residency over time on one
+budgeted device, with the same static-shape bucket discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.serving.buckets import next_pow2
+from photon_ml_tpu.utils.vocab import SortedVocab
+
+# Registry mirrors of the per-instance ``_stats`` (no-ops while
+# telemetry is off); names are part of the metrics.json snapshot schema
+# (docs/OBSERVABILITY.md).
+_M_HITS = telemetry.counter("data.factor_cache.hits")
+_M_MISSES = telemetry.counter("data.factor_cache.misses")
+_M_EVICTIONS = telemetry.counter("data.factor_cache.evictions")
+_M_REUPLOAD_BYTES = telemetry.counter("data.factor_cache.bytes_reuploaded")
+_M_SPILL_WRITTEN = telemetry.counter("data.factor_cache.spill_bytes_written")
+_M_REDECODES = telemetry.counter("data.factor_cache.redecodes")
+_G_DEVICE_BYTES = telemetry.gauge("data.factor_cache.device_bytes")
+_G_PEAK_BYTES = telemetry.gauge("data.factor_cache.peak_device_bytes")
+_G_SPILL_HOST = telemetry.gauge("data.factor_cache.spill_bytes_host")
+
+FACTOR_SPILL_DTYPES = ("f32", "bf16")
+FACTOR_SPILL_SOURCES = ("buffer", "redecode")
+
+
+# ---------------------------------------------------------------------------
+# ALX-style planning: observation-count classes -> pow-2 padded shards
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorShardSpec:
+    """One factor shard: a pow-2-padded slice of one observation-count
+    class. ``codes`` are GLOBAL entity codes (indexes into the plan's
+    vocabulary), ascending — the slot order inside the shard."""
+
+    index: int
+    obs_bucket: int  # pow-2 observation-count class (next_pow2(count))
+    codes: np.ndarray  # i64[n_entities], ascending
+    e_pad: int  # pow-2 padded entity axis
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.codes)
+
+
+@dataclasses.dataclass
+class FactorPlan:
+    """Deterministic entity -> (shard, slot) assignment.
+
+    ``vocabulary`` is the SORTED unique entity-name array (the same
+    ordering `GameDataset.build` / `np.unique` produces, so plan codes
+    and in-core model codes agree); ``counts[c]`` is entity c's
+    observation count. Zero-observation entities are planned too — they
+    ride the smallest density class and solve to exactly zero factors
+    (ridge normal equations with A = 0, b = 0)."""
+
+    vocabulary: np.ndarray
+    counts: np.ndarray
+    shards: List[FactorShardSpec]
+    shard_of_code: np.ndarray  # i32[n_codes]
+    slot_of_code: np.ndarray  # i32[n_codes]
+
+    def __post_init__(self):
+        self._sorted = SortedVocab.build(self.vocabulary)
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.vocabulary)
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+    def codes_of(self, names) -> np.ndarray:
+        """Global entity codes for a batch of names (-1 when unknown —
+        the standard missing-join semantics)."""
+        return self._sorted.codes_of(names)
+
+    def obs_bucket_histogram(self) -> Dict[int, int]:
+        """entities per pow-2 observation-count class (the ALX density
+        histogram — reported in stream_train telemetry)."""
+        out: Dict[int, int] = {}
+        for s in self.shards:
+            out[s.obs_bucket] = out.get(s.obs_bucket, 0) + s.n_entities
+        return out
+
+
+def plan_factors(vocabulary, counts, entities_per_shard: int = 512,
+                 min_entities_pad: int = 8) -> FactorPlan:
+    """Bucket entities ALX-style by observation count, then shard.
+
+    Classes are ``next_pow2(count)`` (zero-count entities join the
+    smallest class); within a class entities keep ascending code order;
+    each class is cut into runs of at most ``entities_per_shard`` and
+    padded to ``e_pad = next_pow2(len)`` (>= ``min_entities_pad``).
+    Everything is sorted, so the plan — and the fixed shard replay
+    order — is a pure function of its inputs."""
+    vocabulary = np.asarray(vocabulary)
+    counts = np.asarray(counts, np.int64)
+    if len(vocabulary) != len(counts):
+        raise ValueError(
+            f"vocabulary has {len(vocabulary)} entities, counts has "
+            f"{len(counts)}")
+    if entities_per_shard < 1:
+        raise ValueError(
+            f"entities_per_shard must be >= 1, got {entities_per_shard}")
+    n = len(vocabulary)
+    # Vectorized next_pow2 over the whole counts column (the per-entity
+    # python loop was O(entities) interpreter work at a subsystem whose
+    # target is millions of entities): frexp is exact for ints < 2^53 —
+    # v = m * 2^e with m in [0.5, 1), so next_pow2(v) is 2^(e-1) when v
+    # is itself a power of two (m == 0.5) and 2^e otherwise.
+    v = np.maximum(counts, 1).astype(np.float64)
+    m, e = np.frexp(v)
+    cls_of = np.where(m == 0.5, np.left_shift(np.int64(1), e - 1),
+                      np.left_shift(np.int64(1), e))
+    order = np.lexsort((np.arange(n, dtype=np.int64), cls_of))
+
+    shards: List[FactorShardSpec] = []
+    shard_of = np.full(n, -1, np.int32)
+    slot_of = np.full(n, -1, np.int32)
+    classes, starts = np.unique(cls_of[order], return_index=True)
+    bounds = list(starts) + [n]
+    for ci, cls in enumerate(classes):
+        codes = order[bounds[ci]:bounds[ci + 1]]  # ascending by code
+        for start in range(0, len(codes), entities_per_shard):
+            run = codes[start:start + entities_per_shard]
+            e_pad = max(next_pow2(len(run)), min_entities_pad)
+            idx = len(shards)
+            shards.append(FactorShardSpec(
+                index=idx, obs_bucket=int(cls), codes=run, e_pad=e_pad))
+            shard_of[run] = idx
+            slot_of[run] = np.arange(len(run), dtype=np.int32)
+    return FactorPlan(vocabulary=vocabulary, counts=counts, shards=shards,
+                      shard_of_code=shard_of, slot_of_code=slot_of)
+
+
+def count_stream_entities(stream, re_type: str):
+    """One bounded-memory pass over a GameDataset stream: the global
+    entity vocabulary (sorted unique names — the `np.unique` order the
+    in-core path uses) and per-entity observation counts. Host state is
+    O(entities), never O(rows). Returns
+    ``(vocabulary, counts, n_rows, n_features_by_shard)``."""
+    vocab = np.zeros(0, dtype="U1")
+    cts = np.zeros(0, np.int64)
+    n_rows = 0
+    d_by_shard: Dict[str, int] = {}
+    for ds in stream:
+        if ds.num_rows == 0:
+            continue
+        col = ds.id_columns.get(re_type)
+        if col is None:
+            raise ValueError(
+                f"stream batches carry no {re_type!r} id column — pass "
+                "id_types=[random_effect_type] to the stream")
+        names, per = np.unique(col.vocabulary[col.codes],
+                               return_counts=True)
+        # Vectorized running merge: host state stays O(entities), and
+        # no per-name python loop runs (the batch's unique names fold
+        # into the running sorted vocabulary in one unique + add).
+        all_names = np.concatenate([vocab, names.astype(str)])
+        all_counts = np.concatenate([cts, per.astype(np.int64)])
+        vocab, inv = np.unique(all_names, return_inverse=True)
+        cts = np.zeros(len(vocab), np.int64)
+        np.add.at(cts, inv, all_counts)
+        n_rows += ds.num_rows
+        for s, mat in ds.feature_shards.items():
+            d_by_shard[s] = mat.shape[1]
+    if n_rows == 0:
+        raise ValueError("stream yielded no rows to plan factors from")
+    return vocab, cts, n_rows, d_by_shard
+
+
+# ---------------------------------------------------------------------------
+# Spill codec: f32 verbatim / bf16 half-width, widened back on device
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FactorSpill:
+    """Host spill record of one evicted factor shard: the ``f32`` tag
+    holds the evicted bytes verbatim; ``bf16`` holds the half-width
+    quantized table (lossless w.r.t. the resident copy, which was
+    quantized at write). Consumed ONLY by
+    :func:`restore_spilled_factors`."""
+
+    enc: np.ndarray  # f32[e_pad, k] | bfloat16[e_pad, k]
+    dtype_tag: str  # "f32" | "bf16"
+
+    @property
+    def nbytes(self) -> int:
+        return self.enc.nbytes
+
+
+@functools.lru_cache(maxsize=1)
+def _widen_jit():
+    """One process-wide jitted bf16 -> f32 widen (built on first
+    restore so importing this module never imports jax); traces once
+    per (e_pad, k)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda g: g.astype(jnp.float32))
+
+
+@functools.lru_cache(maxsize=1)
+def _quantize_jit():
+    """One process-wide jitted f32 -> bf16 -> f32 round trip — the
+    write-time quantization that makes bf16 factor trains
+    residency-independent (every write takes it, evicted or not)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(
+        lambda g: g.astype(jnp.bfloat16).astype(jnp.float32))
+
+
+def encode_factor_spill(gamma_host: np.ndarray,
+                        spill_dtype: str) -> FactorSpill:
+    """Host gamma table -> spill record. ``gamma_host`` is the np view
+    of the (already write-quantized, for bf16) resident table, so the
+    bf16 cast here is lossless and the round trip restores the exact
+    resident bits."""
+    if spill_dtype not in FACTOR_SPILL_DTYPES:
+        raise ValueError(
+            f"spill_dtype must be one of {FACTOR_SPILL_DTYPES}, got "
+            f"{spill_dtype!r}")
+    if spill_dtype == "f32":
+        return FactorSpill(enc=np.asarray(gamma_host, np.float32),
+                           dtype_tag="f32")
+    import ml_dtypes
+
+    return FactorSpill(
+        enc=np.asarray(gamma_host).astype(ml_dtypes.bfloat16),
+        dtype_tag="bf16")
+
+
+def restore_spilled_factors(spill: FactorSpill):
+    """The ONE blessed spill -> device path for factors: f32 re-uploads
+    the evicted bytes verbatim; bf16 uploads the half-width encoding
+    and widens on device."""
+    import jax.numpy as jnp
+
+    if spill.dtype_tag == "f32":
+        return jnp.asarray(spill.enc)
+    return _widen_jit()(jnp.asarray(spill.enc))
+
+
+# ---------------------------------------------------------------------------
+# The cache: budgeted factor-shard residency with replay-aware eviction
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FactorShard:
+    """One planned shard's residency state. ``gamma`` is the canonical
+    device table (None = evicted); ``spill`` its host record (None for
+    the redecode tier, where a miss re-derives from observations)."""
+
+    spec: FactorShardSpec
+    gamma: object = None  # device f32[e_pad, k] | None
+    spill: Optional[FactorSpill] = None
+    written: bool = False  # at least one sweep wrote this shard
+    _k: int = 0  # num_factors, set by the cache at construction
+
+    @property
+    def factor_bytes(self) -> int:
+        # Device-resident cost at the padded f32 shape (bf16 restore
+        # widens back to f32, like the feature cache's contract).
+        return 4 * self.spec.e_pad * self._k
+
+    @property
+    def spill_bytes(self) -> int:
+        return 0 if self.spill is None else self.spill.nbytes
+
+
+class DeviceFactorCache:
+    """Budgeted device residency for the factor tables of one streamed
+    MF coordinate (module docstring). The alternating sweep WRITES
+    shards in fixed order (gamma pass) and READS them in the same order
+    (model assembly; redecode re-derivation) — a cyclic scan, so
+    eviction uses the same furthest-next-use rule as the feature
+    cache. ``redecode`` (set per sweep via :meth:`set_redecode`) is the
+    observation-side re-derivation hook: ``fn(shard_index) -> device
+    f32[e_pad, k]``, required on a miss in the ``redecode`` tier."""
+
+    def __init__(self, plan: FactorPlan, num_factors: int,
+                 hbm_budget_bytes: Optional[int] = None,
+                 spill_dtype: str = "f32",
+                 spill_source: str = "buffer",
+                 redecode: Optional[Callable] = None):
+        if spill_dtype not in FACTOR_SPILL_DTYPES:
+            raise ValueError(
+                f"spill_dtype must be one of {FACTOR_SPILL_DTYPES}, got "
+                f"{spill_dtype!r}")
+        if spill_source not in FACTOR_SPILL_SOURCES:
+            raise ValueError(
+                f"spill_source must be one of {FACTOR_SPILL_SOURCES}, "
+                f"got {spill_source!r}")
+        if spill_source == "redecode" and spill_dtype != "f32":
+            raise ValueError(
+                f"spill_dtype={spill_dtype!r} compresses host spill "
+                "buffers, but spill_source='redecode' keeps none — the "
+                "combination would silently train as f32 while "
+                "reporting bf16; pick one")
+        if num_factors < 1:
+            raise ValueError(f"num_factors must be >= 1, got {num_factors}")
+        self.plan = plan
+        self.k = int(num_factors)
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.spill_dtype = spill_dtype
+        self.spill_source = spill_source
+        self._redecode = redecode
+        self._entries = [FactorShard(spec=s, _k=self.k)
+                         for s in plan.shards]
+        self._stats = {"hits": 0, "misses": 0, "evictions": 0,
+                       "bytes_reuploaded": 0, "spill_bytes_written": 0,
+                       "redecodes": 0}
+        self.device_bytes = 0
+        self.peak_device_bytes = 0
+        _G_SPILL_HOST.set(0)
+
+    # -- wiring ------------------------------------------------------------
+
+    def set_redecode(self, fn: Optional[Callable]) -> None:
+        """Install the observation-side re-derivation hook for the
+        current sweep (the hook closes over the sweep's projection
+        matrix, so the solver refreshes it every gamma pass)."""
+        self._redecode = fn
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._entries)
+
+    @property
+    def entries(self) -> List[FactorShard]:
+        return list(self._entries)
+
+    @property
+    def spill_bytes_host(self) -> int:
+        return sum(e.spill_bytes for e in self._entries)
+
+    def e_pad_buckets(self) -> set:
+        return {e.spec.e_pad for e in self._entries}
+
+    # -- residency ---------------------------------------------------------
+
+    def write(self, index: int, gamma):
+        """Commit one shard's freshly solved factors as the canonical
+        copy (the gamma pass calls this in fixed shard order). bf16
+        trains quantize HERE — at write, unconditionally — so the
+        stored (and returned) table is identical whether or not the
+        shard ever spills; callers must use the RETURNED array (not
+        their input) for anything feeding the model bytes. Stale spill
+        records are dropped (the new write supersedes them); the budget
+        is enforced with this shard pinned."""
+        import jax.numpy as jnp
+
+        e = self._entries[index]
+        gamma = jnp.asarray(gamma, jnp.float32)
+        if gamma.shape != (e.spec.e_pad, self.k):
+            raise ValueError(
+                f"factor shard {index} write has shape {gamma.shape}, "
+                f"expected {(e.spec.e_pad, self.k)}")
+        if self.spill_dtype == "bf16":
+            gamma = _quantize_jit()(gamma)
+        if e.gamma is None:
+            self.device_bytes += e.factor_bytes
+        e.gamma = gamma
+        if e.spill is not None:
+            e.spill = None  # superseded by this write
+            _G_SPILL_HOST.set(self.spill_bytes_host)
+        e.written = True
+        self.peak_device_bytes = max(self.peak_device_bytes,
+                                     self.device_bytes)
+        _G_PEAK_BYTES.set(self.peak_device_bytes)
+        _G_DEVICE_BYTES.set(self.device_bytes)
+        self._enforce_budget(pinned=index)
+        return gamma
+
+    def ensure(self, index: int):
+        """Resident factors for one shard, restoring on a miss: buffer
+        spill re-uploads the host record; the redecode tier re-derives
+        from observations via the hook. Never-written shards raise —
+        a read before the first gamma pass is a sequencing bug."""
+        e = self._entries[index]
+        if not e.written:
+            raise RuntimeError(
+                f"factor shard {index} was never written — run a gamma "
+                "pass before reading factors")
+        if e.gamma is not None:
+            self._stats["hits"] += 1
+            _M_HITS.inc()
+            return e.gamma
+        self._stats["misses"] += 1
+        _M_MISSES.inc()
+        if e.spill is not None:
+            reupload = e.spill.nbytes
+            with telemetry.span("factor_reupload"):
+                gamma = restore_spilled_factors(e.spill)
+        elif self._redecode is not None:
+            reupload = e.factor_bytes
+            self._stats["redecodes"] += 1
+            _M_REDECODES.inc()
+            with telemetry.span("factor_redecode"):
+                gamma = self._redecode(index)
+            import jax.numpy as jnp
+
+            gamma = jnp.asarray(gamma, jnp.float32)
+            if gamma.shape != (e.spec.e_pad, self.k):
+                raise RuntimeError(
+                    f"redecode hook returned shape {gamma.shape} for "
+                    f"shard {index}, expected {(e.spec.e_pad, self.k)}")
+        else:
+            raise RuntimeError(
+                f"factor shard {index} was evicted but has no spill "
+                "record and no redecode hook (cache built without an "
+                "hbm budget?)")
+        self._stats["bytes_reuploaded"] += reupload
+        _M_REUPLOAD_BYTES.inc(reupload)
+        e.gamma = gamma
+        self.device_bytes += e.factor_bytes
+        self.peak_device_bytes = max(self.peak_device_bytes,
+                                     self.device_bytes)
+        _G_PEAK_BYTES.set(self.peak_device_bytes)
+        _G_DEVICE_BYTES.set(self.device_bytes)
+        self._enforce_budget(pinned=index)
+        return e.gamma
+
+    def _enforce_budget(self, pinned: int) -> None:
+        """Evict until within budget. Victim = resident shard whose
+        next use is FURTHEST in the fixed cyclic sweep order from the
+        shard in hand (the feature cache's Belady-on-cyclic-replay
+        rule; the in-hand shard is never evicted). Eviction in the
+        buffer tiers encodes a fresh spill record (factors MUTATE per
+        sweep, unlike feature blocks — the record must capture the
+        latest write); the redecode tier drops the table outright."""
+        budget = self.hbm_budget_bytes
+        if budget is None:
+            return
+        n = len(self._entries)
+        cur = pinned if pinned >= 0 else 0
+        if self.device_bytes <= budget:
+            return
+        resident = [e for e in self._entries
+                    if e.gamma is not None and e.spec.index != pinned]
+        resident.sort(key=lambda e: -((e.spec.index - cur) % n))
+        while self.device_bytes > budget and resident:
+            victim = resident.pop(0)
+            # A victim with a live spill record was restored and never
+            # rewritten (write() is the only place that clears spill),
+            # so the record is still byte-identical — re-encoding would
+            # pay a redundant device→host pull and double-count the
+            # spill_bytes_written accounting.
+            if self.spill_source == "buffer" and victim.spill is None:
+                spill = encode_factor_spill(
+                    np.asarray(victim.gamma), self.spill_dtype)
+                victim.spill = spill
+                self._stats["spill_bytes_written"] += spill.nbytes
+                _M_SPILL_WRITTEN.inc(spill.nbytes)
+            victim.gamma = None
+            self.device_bytes -= victim.factor_bytes
+            self._stats["evictions"] += 1
+            _M_EVICTIONS.inc()
+        _G_DEVICE_BYTES.set(self.device_bytes)
+        _G_SPILL_HOST.set(self.spill_bytes_host)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict:
+        s = dict(self._stats)
+        s.update({
+            "shards": self.n_shards,
+            "entities": self.plan.num_entities,
+            "num_factors": self.k,
+            "e_pad_buckets": sorted(self.e_pad_buckets()),
+            "obs_bucket_histogram": {
+                str(k): v
+                for k, v in sorted(
+                    self.plan.obs_bucket_histogram().items())},
+            "hbm_budget_bytes": self.hbm_budget_bytes,
+            "device_bytes": self.device_bytes,
+            "peak_device_bytes": self.peak_device_bytes,
+            "spill_dtype": self.spill_dtype,
+            "spill_source": self.spill_source,
+            "spill_bytes_host": self.spill_bytes_host,
+            "resident_shards": sum(1 for e in self._entries
+                                   if e.gamma is not None),
+        })
+        return s

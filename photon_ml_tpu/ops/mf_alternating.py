@@ -1,0 +1,734 @@
+"""Streamed alternating least squares for the GAME MF coordinate.
+
+The in-core `FactoredRandomEffectCoordinate` materializes every entity's
+observation block densely on device and alternates vmapped per-entity
+L-BFGS solves with an in-core projection-matrix refit — capping the MF
+leg of GAME at HBM. This module is the out-of-core replacement
+(PAPERS.md "ALX: Large Scale Matrix Factorization on TPUs" — sharded
+factor tables, density-bucketed batched solves; Snap ML's streamed
+chunk pipeline for the observation side):
+
+- **Observations stream** through `BlockGameStream`, one bounded batch
+  at a time, re-decoded per feature pass (the PR-10 ``redecode`` epoch
+  shape): host memory stays O(one block) for features. Row-space state
+  — labels / offsets / weights, cached margins, and the per-row factor
+  gather — is device-resident at O((20 + 4k) bytes/row), the same
+  always-resident row-column contract as the feature shard cache.
+- **Factors live in a `DeviceFactorCache`** (data/factor_cache.py):
+  entities bucketed ALX-style by observation count into pow-2 classes,
+  shard residency bounded by ``--hbm-budget`` with replay-aware
+  eviction and the PR-10 spill tiers (f32 / bf16 / redecode-from-
+  observations).
+- **The gamma half-step is exact ridge ALS**: per-entity normal
+  equations ``(Σ w v vᵀ + λ₂ I) γ = Σ w (y - off) v`` with
+  ``v = B x`` accumulate STREAMING over the observation pass (per-batch
+  jitted projection + segment-sum, host f32 batch-order accumulation
+  into per-shard tables), then one batched per-bucket jitted solve per
+  factor shard — the batched per-entity solve shape of the fused Pallas
+  entity solver, with the normal-equation direct solve standing in for
+  its iterative kernel (squared loss has a closed form; there is no
+  warm start, so a shard's factors are a PURE FUNCTION of
+  (observations, B) — what makes the redecode spill tier bit-exact).
+- **The B half-step reuses the streamed L-BFGS wholesale**:
+  `StreamedMFObjective` exposes the same margin-cached surface as
+  `ShardedGLMObjective` (margins_value_grad / margin_direction_list /
+  trial_values / update_margins / grad_from_margins_list), so
+  `optimization.glm_lbfgs.minimize_lbfgs_glm_streaming` drives the
+  refit unchanged — 2 feature passes per outer iteration, zero-pass
+  Armijo sweeps, and the PR-11 divergence watchdog for free.
+
+Compile discipline: every kernel is built once per objective instance
+and registered with a `TracingGuard`; budgets are stated in terms of
+the OBSERVED bucket geometry (feature-shape buckets, entity-pad
+buckets), never entity or row counts — `assert_trace_budget` makes the
+"compiles scale with bucket count" claim assertable, not hand-counted.
+
+Determinism contract (tested): for a fixed stream, the trained factor
+and projection bytes are identical across factor-cache residency
+(budget sizes), feeder variants, and prefetch depths — f32 spill
+restores evicted bytes verbatim, bf16 quantizes once at write, and
+redecode re-derives evicted shards through the SAME kernels over
+byte-identical re-decoded batches in the same accumulation order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.device_feed import chunked_device_put
+from photon_ml_tpu.data.factor_cache import DeviceFactorCache, FactorPlan
+from photon_ml_tpu.ops.features import CSRFeatures, padded_csr_arrays
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.serving.buckets import BucketLadder, next_pow2
+from photon_ml_tpu.telemetry import span
+from photon_ml_tpu.utils.tracing_guard import TracingGuard
+
+#: Distinct jitted kernel families the objective may build; each traces
+#: within its observed-geometry budget (see assert_trace_budget).
+MF_KERNEL_FAMILIES = 10
+
+
+@dataclasses.dataclass
+class _BatchGeom:
+    """One streamed batch's static geometry + resident row-space state
+    (built on the objective's first feature pass, validated against
+    every later pass — the input must not change under the stream)."""
+
+    index: int
+    row_offset: int
+    n_rows: int
+    nnz: int
+    rows_bucket: int
+    nnz_bucket: int
+    u_bucket: int
+    labels: object  # device f32[rows_bucket]
+    offsets_raw: object  # device f32[rows_bucket] (no residual)
+    weights: object  # device f32[rows_bucket]
+    seg_ids: object  # device i32[rows_bucket]: batch-local entity slot
+    uniq_shards: np.ndarray  # i32[n_uniq]: factor shard per unique entity
+    uniq_slots: np.ndarray  # i32[n_uniq]: slot within that shard
+    n_uniq: int = 0
+    _off_eff: object = None  # cached effective offsets (residual added)
+    _off_gen: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class _ShardRows:
+    """Per-factor-shard row routing for the post-solve scatter into the
+    row-space factor gather table (pad entries point at the sentinel
+    row, slot 0)."""
+
+    rows: object  # device i32[m_pad]: global row ids, ascending
+    slots: object  # device i32[m_pad]: entity slot within the shard
+    m_pad: int
+
+
+class StreamedMFObjective:
+    """Streamed MF state + kernels for ONE factored coordinate.
+
+    ``make_stream`` is a zero-arg callable returning a fresh iterable of
+    `GameDataset` batches (a `BlockGameStream` factory in the driver; any
+    deterministic replayable source in tests). ``random_access`` is an
+    optional ``fetch(row_start, n_rows) -> GameDataset`` hook
+    (`BlockRandomAccess`) the redecode tier uses to re-fetch ONLY a
+    shard's covering batches; without it redecode falls back to a full
+    filtered re-stream (correct, but it decodes the whole container per
+    miss — fine at test scale, documented in docs/SCALE.md).
+    """
+
+    def __init__(self, make_stream: Callable, feature_shard_id: str,
+                 random_effect_type: str, plan: FactorPlan,
+                 cache: DeviceFactorCache, n_features: int,
+                 loss: PointwiseLoss,
+                 tracing_guard: Optional[TracingGuard] = None,
+                 random_access: Optional[Callable] = None,
+                 min_rows_bucket: int = 16):
+        if cache.plan is not plan:
+            raise ValueError("cache must be built over the same FactorPlan")
+        self.make_stream = make_stream
+        self.shard_id = feature_shard_id
+        self.re_type = random_effect_type
+        self.plan = plan
+        self.cache = cache
+        self.k = cache.k
+        self.d = int(n_features)
+        self.loss = loss
+        self.guard = tracing_guard if tracing_guard is not None \
+            else TracingGuard()
+        self.random_access = random_access
+        self._min_rows_bucket = min_rows_bucket
+        self.n_rows = 0  # settled by the first pass
+        self._geoms: Optional[List[_BatchGeom]] = None
+        self._ladder: Optional[BucketLadder] = None
+        self._G = None  # device f32[g_size, k] row-space factor gather
+        self._g_size = 0
+        self._shard_rows: Dict[int, _ShardRows] = {}
+        self._touch: Dict[int, List[int]] = {}  # shard -> batch indices
+        self._B_sweep = None  # the gamma pass's B (redecode closes over it)
+        self._l2_sweep = None
+        self._res = None  # residual scores (device, padded)
+        self._res_gen = 0
+        self._kit = self._build_kit()
+
+    # -- kernels -----------------------------------------------------------
+
+    def _build_kit(self) -> Dict[str, object]:
+        """The per-instance jitted kernel kit (one trace per observed
+        bucket shape; registered in the TracingGuard under ``mf:*``).
+        Row-space REDUCTIONS slice to the batch's true row count ``n``
+        (static) exactly like the sharded GLM kit — XLA's vectorized
+        reduce is not prefix-stable under zero-padding; the
+        normal-equation segment sums instead rely on exact-zero padding
+        contributions (pad rows carry weight 0 AND an all-zero
+        projection), which replays reproduce bit for bit."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        loss = self.loss
+        k, d = self.k, self.d
+
+        def v_of(feats, B):
+            # [rows, k] latent projections V = X Bᵀ: one gather + one
+            # segment-sum over the padded triplet (pad entries are
+            # value-0 at row 0, so they contribute +0).
+            contrib = feats.values[:, None] * B[:, feats.col_ids].T
+            return jax.ops.segment_sum(contrib, feats.row_ids,
+                                       num_segments=feats.n_rows)
+
+        def g_slice(G, off, rows_bucket: int):
+            return lax.dynamic_slice(G, (off, jnp.zeros((), off.dtype)),
+                                     (rows_bucket, k))
+
+        def gamma_kernel(feats, labels, offsets, weights, seg, B,
+                         u: int):
+            """Per-batch normal-equation partials, segment-summed over
+            the batch's unique entities: A_u [u, k, k], b_u [u, k]."""
+            v = v_of(feats, B)
+            t = labels - offsets
+            a_rows = weights[:, None, None] * v[:, :, None] * v[:, None, :]
+            b_rows = (weights * t)[:, None] * v
+            return (jax.ops.segment_sum(a_rows, seg, num_segments=u),
+                    jax.ops.segment_sum(b_rows, seg, num_segments=u))
+
+        def gsolve_kernel(A, b, l2):
+            """Batched ridge solve per entity: (A + λ₂ I)⁻¹ b. Strictly
+            convex for λ₂ > 0, so zero-observation entities (A = 0,
+            b = 0) solve to exactly zero factors."""
+            eye = jnp.eye(A.shape[-1], dtype=A.dtype)
+            return jnp.linalg.solve(A + l2 * eye, b[..., None])[..., 0]
+
+        def gscatter_kernel(G, rows, gamma, slots):
+            """Write one solved shard's factors into the row-space
+            gather table (pads target the sentinel row)."""
+            return G.at[rows].set(gamma[slots])
+
+        def init_kernel(feats, labels, offsets, weights, G, off, B,
+                        n: int):
+            """Margins + value partial + B-gradient partial, one pass."""
+            v = v_of(feats, B)
+            g_rows = g_slice(G, off, feats.n_rows)
+            z = jnp.sum(v * g_rows, axis=-1) + offsets
+            val = jnp.sum((weights * loss.loss(z, labels))[:n])
+            u_vec = weights * loss.d1(z, labels)
+            contrib = (u_vec[feats.row_ids] * feats.values)[:, None] \
+                * g_rows[feats.row_ids]
+            g_t = jax.ops.segment_sum(contrib, feats.col_ids,
+                                      num_segments=d)
+            return z, val, g_t.T
+
+        def dir_kernel(feats, G, off, direction):
+            """Directional margins for a [k, d] direction (also the
+            raw-margin scoring kernel: score = γᵀ B x, offsets
+            excluded per the coordinate score contract)."""
+            v = v_of(feats, direction)
+            return jnp.sum(v * g_slice(G, off, feats.n_rows), axis=-1)
+
+        def grad_kernel(feats, labels, weights, z, G, off):
+            u_vec = weights * loss.d1(z, labels)
+            g_rows = g_slice(G, off, feats.n_rows)
+            contrib = (u_vec[feats.row_ids] * feats.values)[:, None] \
+                * g_rows[feats.row_ids]
+            g_t = jax.ops.segment_sum(contrib, feats.col_ids,
+                                      num_segments=d)
+            return g_t.T
+
+        def trial_kernel(z, zp, labels, weights, ts, n: int):
+            """[K] weighted-loss sums at z + t*zp — the same expressions
+            as the sharded GLM trial kernel, so the batched Armijo sweep
+            is feature-pass-free here too."""
+            z_t = z[None, :n] + ts[:, None] * zp[None, :n]
+            return jnp.sum(
+                weights[None, :n] * loss.loss(z_t, labels[None, :n]),
+                axis=-1)
+
+        def axpy_kernel(a, t, b):
+            return a + t * b
+
+        def acc_kernel(acc, part):
+            return jax.tree.map(jnp.add, acc, part)
+
+        def resadd_kernel(off_raw, res_ext, off0):
+            """Effective offsets = raw offsets + the coordinate-descent
+            residual slice for this batch's global row range."""
+            return off_raw + lax.dynamic_slice(
+                res_ext, (off0,), (off_raw.shape[0],))
+
+        kit = {
+            "gamma": jax.jit(gamma_kernel, static_argnames=("u",)),
+            "gsolve": jax.jit(gsolve_kernel),
+            "gscatter": jax.jit(gscatter_kernel),
+            "init": jax.jit(init_kernel, static_argnames=("n",)),
+            "dir": jax.jit(dir_kernel),
+            "grad": jax.jit(grad_kernel),
+            "trial": jax.jit(trial_kernel, static_argnames=("n",)),
+            "axpy": jax.jit(axpy_kernel),
+            "acc": jax.jit(acc_kernel),
+            "resadd": jax.jit(resadd_kernel),
+        }
+        for name, fn in kit.items():
+            self.guard.track(f"mf:{name}", fn)
+        return kit
+
+    # -- streaming geometry ------------------------------------------------
+
+    def _ensure_built(self) -> None:
+        """Build the streaming geometry on first use: one dedicated
+        decode pass that settles batch shapes, resident row columns,
+        entity routing, and the row-space factor-gather table. Feature
+        triplets are NOT retained — every later feature pass re-decodes
+        them (the out-of-core contract)."""
+        if self._geoms is not None:
+            return
+        self._geoms = []
+        route: Dict[int, List] = {}
+        row_offset = 0
+        for ds in self.make_stream():
+            if ds.num_rows == 0:
+                continue
+            mat = ds.feature_shards[self.shard_id].tocsr()
+            if self._ladder is None:
+                self._ladder = BucketLadder(
+                    min_rows=min(self._min_rows_bucket,
+                                 next_pow2(ds.num_rows)),
+                    max_rows=next_pow2(ds.num_rows))
+            self._geoms.append(self._build_geom(
+                len(self._geoms), row_offset, ds, mat, route))
+            row_offset += ds.num_rows
+        if not self._geoms:
+            raise ValueError("stream yielded no rows to train on")
+        self.n_rows = row_offset
+        self._finish_geometry(route)
+
+    def _feature_pass(self):
+        """Yield ``(geom, feats)`` per streamed batch, re-decoding the
+        source each call (features are never cached — the out-of-core
+        contract) and validating every batch against the settled
+        geometry."""
+        import jax.numpy as jnp
+
+        self._ensure_built()
+        count = 0
+        for ds in self.make_stream():
+            if ds.num_rows == 0:
+                continue
+            mat = ds.feature_shards[self.shard_id].tocsr()
+            if count >= len(self._geoms):
+                raise RuntimeError(
+                    "stream yielded more batches than the geometry "
+                    "pass — the input changed under the objective")
+            geom = self._geoms[count]
+            if geom.n_rows != ds.num_rows or geom.nnz != int(mat.nnz):
+                raise RuntimeError(
+                    f"streamed batch {count} does not match the "
+                    f"geometry pass ({ds.num_rows} rows/{mat.nnz} nnz "
+                    f"vs {geom.n_rows}/{geom.nnz}) — the input "
+                    "changed under the objective")
+            values, cols, rows = padded_csr_arrays(
+                mat, geom.rows_bucket, geom.nnz_bucket,
+                value_dtype=np.float32)
+            feats = CSRFeatures(
+                chunked_device_put(values), jnp.asarray(cols),
+                jnp.asarray(rows), geom.rows_bucket, self.d)
+            yield geom, feats
+            count += 1
+        if count != len(self._geoms):
+            raise RuntimeError(
+                "stream yielded fewer batches than the geometry pass — "
+                "the input changed under the objective")
+
+    def _build_geom(self, index: int, row_offset: int, ds, mat,
+                    route: Dict[int, List]) -> _BatchGeom:
+        import jax.numpy as jnp
+
+        n = ds.num_rows
+        rb = self._ladder.rows_bucket(n)
+        nb = self._ladder.nnz_bucket(mat.nnz, rb)
+        col = ds.id_columns.get(self.re_type)
+        if col is None:
+            raise ValueError(
+                f"stream batches carry no {self.re_type!r} id column — "
+                "pass id_types=[random_effect_type] to the stream")
+        codes = self.plan.codes_of(col.vocabulary[col.codes])
+        if (codes < 0).any():
+            raise RuntimeError(
+                f"batch {index} carries entities unseen at planning "
+                "time — the input changed under the objective")
+        uniq, inv = np.unique(codes, return_inverse=True)
+        seg = np.zeros(rb, np.int32)
+        seg[:n] = inv
+        uniq_shards = self.plan.shard_of_code[uniq]
+        uniq_slots = self.plan.slot_of_code[uniq]
+        rows_glob = row_offset + np.arange(n, dtype=np.int64)
+        shard_per_row = self.plan.shard_of_code[codes]
+        slot_per_row = self.plan.slot_of_code[codes]
+        for s in np.unique(shard_per_row):
+            mask = shard_per_row == s
+            route.setdefault(int(s), []).append(
+                (rows_glob[mask], slot_per_row[mask]))
+            self._touch.setdefault(int(s), []).append(index)
+
+        def colpad(x):
+            out = np.zeros(rb, np.float32)
+            out[:n] = x
+            return jnp.asarray(out)
+
+        return _BatchGeom(
+            index=index, row_offset=row_offset, n_rows=n,
+            nnz=int(mat.nnz), rows_bucket=rb, nnz_bucket=nb,
+            u_bucket=max(next_pow2(len(uniq)), 1),
+            labels=colpad(ds.responses), offsets_raw=colpad(ds.offsets),
+            weights=colpad(ds.weights), seg_ids=jnp.asarray(seg),
+            uniq_shards=uniq_shards.astype(np.int32),
+            uniq_slots=uniq_slots.astype(np.int32), n_uniq=len(uniq))
+
+    def _finish_geometry(self, route: Dict[int, List]) -> None:
+        """Freeze the first pass's routing: the row-space factor-gather
+        table (zeros — the initial factors) and per-shard scatter
+        indices, pads pointing at the sentinel row."""
+        import jax.numpy as jnp
+
+        self._g_size = max(g.row_offset + g.rows_bucket
+                           for g in self._geoms) + 1
+        sentinel = self._g_size - 1
+        self._G = jnp.zeros((self._g_size, self.k), jnp.float32)
+        for spec in self.plan.shards:
+            parts = route.get(spec.index, [])
+            rows = (np.concatenate([p[0] for p in parts])
+                    if parts else np.zeros(0, np.int64))
+            slots = (np.concatenate([p[1] for p in parts])
+                     if parts else np.zeros(0, np.int64))
+            m_pad = max(next_pow2(len(rows)), 8)
+            rows_p = np.full(m_pad, sentinel, np.int32)
+            rows_p[:len(rows)] = rows
+            slots_p = np.zeros(m_pad, np.int32)
+            slots_p[:len(slots)] = slots
+            self._shard_rows[spec.index] = _ShardRows(
+                rows=jnp.asarray(rows_p), slots=jnp.asarray(slots_p),
+                m_pad=m_pad)
+
+    # -- residual (coordinate-descent offsets) -----------------------------
+
+    def set_residual(self, residual_scores) -> None:
+        """Install the coordinate-descent residual for subsequent
+        passes (None clears it). The residual is a global [n_rows]
+        score vector; each batch adds its slice to the raw offsets."""
+        import jax.numpy as jnp
+
+        self._res_gen += 1
+        if residual_scores is None:
+            self._res = None
+            return
+        res = np.asarray(residual_scores, np.float32)
+        n = self.n_rows if self.n_rows else len(res)
+        if len(res) != n and self.n_rows:
+            raise ValueError(
+                f"residual has {len(res)} rows, stream has {n}")
+        # Padded so the per-batch dynamic slice [off, off + rows_bucket)
+        # stays in bounds for the final partial batch.
+        ext = np.zeros(len(res) + next_pow2(max(len(res), 1)) + 1,
+                       np.float32)
+        ext[:len(res)] = res
+        self._res = jnp.asarray(ext)
+
+    def _offsets(self, geom: _BatchGeom):
+        if self._res is None:
+            return geom.offsets_raw
+        if geom._off_gen != self._res_gen:
+            geom._off_eff = self._kit["resadd"](
+                geom.offsets_raw, self._res, np.int32(geom.row_offset))
+            geom._off_gen = self._res_gen
+        return geom._off_eff
+
+    # -- gamma half-step: streamed normal equations + batched solves -------
+
+    def gamma_pass(self, B, l2_gamma) -> None:
+        """One alternating sweep's factor update: stream the
+        observations once, accumulating per-entity normal equations
+        (device kernels per batch, host f32 adds in fixed batch order),
+        then solve + commit each factor shard IN FIXED SHARD ORDER
+        (batched per-bucket ridge solve -> cache write -> row-space
+        scatter). Factors are a pure function of (observations, B), so
+        the redecode hook installed here re-derives any later miss bit
+        for bit."""
+        import jax.numpy as jnp
+
+        B_dev = jnp.asarray(B, jnp.float32)
+        l2_dev = jnp.asarray(l2_gamma, jnp.float32)
+        a_tabs: Dict[int, np.ndarray] = {}
+        b_tabs: Dict[int, np.ndarray] = {}
+        with span("accumulate"):
+            for geom, feats in self._feature_pass():
+                a_u, b_u = self._kit["gamma"](
+                    feats, geom.labels, self._offsets(geom),
+                    geom.weights, geom.seg_ids, B_dev, u=geom.u_bucket)
+                self._add_normals(geom, np.asarray(a_u), np.asarray(b_u),
+                                  a_tabs, b_tabs, only_shard=None)
+        self._B_sweep = B_dev
+        self._l2_sweep = l2_dev
+        if self.cache.spill_source == "redecode":
+            self.cache.set_redecode(self._redecode_gamma)
+        for spec in self.plan.shards:
+            with span("factor_solve"):
+                gamma = self._solve_shard(
+                    spec, a_tabs.get(spec.index), b_tabs.get(spec.index),
+                    l2_dev)
+                # The cache's canonical copy (bf16 trains quantize at
+                # write) is what feeds BOTH the model bytes and the B
+                # refit's row gather — never the raw solve output.
+                gamma = self.cache.write(spec.index, gamma)
+                sr = self._shard_rows[spec.index]
+                self._G = self._kit["gscatter"](self._G, sr.rows, gamma,
+                                                sr.slots)
+
+    def _add_normals(self, geom: _BatchGeom, a_h: np.ndarray,
+                     b_h: np.ndarray, a_tabs: Dict, b_tabs: Dict,
+                     only_shard: Optional[int]) -> None:
+        """Fold one batch's per-unique-entity partials into the host
+        per-shard tables (f32, batch order — the deterministic
+        accumulation the redecode path replays)."""
+        m = geom.n_uniq
+        sh, sl = geom.uniq_shards, geom.uniq_slots
+        for s in np.unique(sh):
+            s = int(s)
+            if only_shard is not None and s != only_shard:
+                continue
+            mask = sh == s
+            a_t = a_tabs.get(s)
+            if a_t is None:
+                spec = self.plan.shards[s]
+                a_t = np.zeros((spec.e_pad, self.k, self.k), np.float32)
+                b_t = np.zeros((spec.e_pad, self.k), np.float32)
+                a_tabs[s], b_tabs[s] = a_t, b_t
+            else:
+                b_t = b_tabs[s]
+            a_t[sl[mask]] += a_h[:m][mask]
+            b_t[sl[mask]] += b_h[:m][mask]
+
+    def _solve_shard(self, spec, a_h: Optional[np.ndarray],
+                     b_h: Optional[np.ndarray], l2_dev):
+        import jax.numpy as jnp
+
+        if a_h is None:
+            a_h = np.zeros((spec.e_pad, self.k, self.k), np.float32)
+            b_h = np.zeros((spec.e_pad, self.k), np.float32)
+        return self._kit["gsolve"](jnp.asarray(a_h), jnp.asarray(b_h),
+                                   l2_dev)
+
+    def _redecode_gamma(self, index: int):
+        """Redecode-tier miss path: re-derive one factor shard from its
+        covering observation batches against the sweep's B. With a
+        ``random_access`` fetcher only the covering batches re-decode;
+        otherwise the whole stream replays and non-covering batches are
+        skipped. Same kernels, byte-identical batches, same add order
+        -> bit-identical factors."""
+        import jax.numpy as jnp
+
+        if self._B_sweep is None:
+            raise RuntimeError(
+                "redecode requested before any gamma pass")
+        spec = self.plan.shards[index]
+        touching = set(self._touch.get(index, ()))
+        a_tabs: Dict[int, np.ndarray] = {}
+        b_tabs: Dict[int, np.ndarray] = {}
+        if self.random_access is not None:
+            batches = ((bi, self.random_access(
+                self._geoms[bi].row_offset, self._geoms[bi].n_rows))
+                for bi in sorted(touching))
+        else:
+            batches = ((bi, ds) for bi, ds in enumerate(
+                d for d in self.make_stream() if d.num_rows)
+                if bi in touching)
+        for bi, ds in batches:
+            geom = self._geoms[bi]
+            mat = ds.feature_shards[self.shard_id].tocsr()
+            if mat.shape[0] != geom.n_rows or int(mat.nnz) != geom.nnz:
+                raise RuntimeError(
+                    f"re-decoded batch {bi} does not match the first "
+                    "pass — the input changed under the objective")
+            values, cols, rows = padded_csr_arrays(
+                mat, geom.rows_bucket, geom.nnz_bucket,
+                value_dtype=np.float32)
+            feats = CSRFeatures(
+                chunked_device_put(values), jnp.asarray(cols),
+                jnp.asarray(rows), geom.rows_bucket, self.d)
+            a_u, b_u = self._kit["gamma"](
+                feats, geom.labels, self._offsets(geom), geom.weights,
+                geom.seg_ids, self._B_sweep, u=geom.u_bucket)
+            self._add_normals(geom, np.asarray(a_u), np.asarray(b_u),
+                              a_tabs, b_tabs, only_shard=index)
+        return self._solve_shard(spec, a_tabs.get(index),
+                                 b_tabs.get(index), self._l2_sweep)
+
+    # -- B half-step: the streamed-L-BFGS objective surface ----------------
+    # Duck-typed for optimization.glm_lbfgs.minimize_lbfgs_glm_streaming:
+    # coef is vec(B) [k*d]; margins are affine in B (z = γᵀ B x + off),
+    # so the margin-cached line-search economy carries over verbatim.
+
+    def margins_value_grad(self, coef, l2):
+        import jax.numpy as jnp
+
+        B = jnp.reshape(coef, (self.k, self.d))
+        z_list: List = []
+        acc = None
+        with span("accumulate"):
+            for geom, feats in self._feature_pass():
+                z, val, g = self._kit["init"](
+                    feats, geom.labels, self._offsets(geom),
+                    geom.weights, self._G, np.int32(geom.row_offset), B,
+                    n=geom.n_rows)
+                z_list.append(z)
+                part = (val, g)
+                acc = part if acc is None else self._kit["acc"](acc, part)
+        val, g = acc
+        f = val + 0.5 * l2 * jnp.vdot(coef, coef)
+        return z_list, f, jnp.reshape(g, (-1,)) + l2 * coef
+
+    def value_and_grad(self, coef, l2=0.0):
+        import jax.numpy as jnp
+
+        _, f, g = self.margins_value_grad(coef, jnp.asarray(l2))
+        return f, g
+
+    def margin_direction_list(self, direction) -> List:
+        import jax.numpy as jnp
+
+        d_mat = jnp.reshape(direction, (self.k, self.d))
+        out: List = []
+        with span("accumulate"):
+            for geom, feats in self._feature_pass():
+                out.append(self._kit["dir"](
+                    feats, self._G, np.int32(geom.row_offset), d_mat))
+        return out
+
+    def trial_values(self, z_list: Sequence, zp_list: Sequence, ts,
+                     coef_sq, l2):
+        """Row-space only — margins are cached, so the whole Armijo
+        sweep costs zero feature passes and zero re-decodes."""
+        acc = None
+        with span("accumulate"):
+            for geom, z, zp in zip(self._geoms, z_list, zp_list):
+                part = self._kit["trial"](z, zp, geom.labels,
+                                          geom.weights, ts,
+                                          n=geom.n_rows)
+                acc = part if acc is None else self._kit["acc"](acc, part)
+        return acc + 0.5 * l2 * coef_sq
+
+    def update_margins(self, z_list: Sequence, t, zp_list: Sequence
+                       ) -> List:
+        return [self._kit["axpy"](z, t, zp)
+                for z, zp in zip(z_list, zp_list)]
+
+    def grad_from_margins_list(self, coef, z_list: Sequence, l2):
+        import jax.numpy as jnp
+
+        acc = None
+        with span("accumulate"):
+            for (geom, feats), z in zip(self._feature_pass(), z_list):
+                part = self._kit["grad"](
+                    feats, geom.labels, geom.weights, z, self._G,
+                    np.int32(geom.row_offset))
+                acc = part if acc is None else self._kit["acc"](acc, part)
+        return jnp.reshape(acc, (-1,)) + l2 * coef
+
+    # -- scoring -----------------------------------------------------------
+
+    def gather_from_tables(self, tables: Sequence):
+        """Row-space factor gather built from EXPLICIT per-shard factor
+        tables ([n_entities, k] each, in plan shard order) — scoring a
+        model must not read the objective's internal solve state, which
+        a later λ-grid point sharing this objective may have
+        overwritten. Reuses the gscatter kernel at the solve path's
+        exact shapes (pad to e_pad first), so no new traces."""
+        import jax.numpy as jnp
+
+        self._ensure_built()
+        if len(tables) != self.plan.n_shards:
+            raise ValueError(
+                f"expected {self.plan.n_shards} factor tables, got "
+                f"{len(tables)}")
+        g = jnp.zeros((self._g_size, self.k), jnp.float32)
+        for spec, table in zip(self.plan.shards, tables):
+            table = jnp.asarray(table, jnp.float32)
+            if table.shape != (spec.n_entities, self.k):
+                raise ValueError(
+                    f"factor table {spec.index} has shape {table.shape},"
+                    f" expected {(spec.n_entities, self.k)}")
+            pad = spec.e_pad - spec.n_entities
+            if pad:
+                table = jnp.pad(table, ((0, pad), (0, 0)))
+            sr = self._shard_rows[spec.index]
+            g = self._kit["gscatter"](g, sr.rows, table, sr.slots)
+        return g
+
+    def score_pass(self, B, tables: Optional[Sequence] = None
+                   ) -> np.ndarray:
+        """Raw margins γᵀ B x per row (offsets excluded — the
+        coordinate score contract), one streamed pass. ``tables``
+        (per-shard factor tables in plan order) scores an explicit
+        model; None uses the most recent solve's row-space gather."""
+        import jax.numpy as jnp
+
+        B_dev = jnp.asarray(B, jnp.float32)
+        g = self._G if tables is None else self.gather_from_tables(tables)
+        out = np.zeros(max(self.n_rows, 1), np.float32)
+        with span("accumulate"):
+            for geom, feats in self._feature_pass():
+                z = self._kit["dir"](feats, g,
+                                     np.int32(geom.row_offset), B_dev)
+                out[geom.row_offset:geom.row_offset + geom.n_rows] = \
+                    np.asarray(z)[:geom.n_rows]
+        return out[:self.n_rows]
+
+    # -- model assembly ----------------------------------------------------
+
+    def factor_tables(self) -> List:
+        """Final per-shard factor tables at TRUE entity counts, read
+        through the cache in fixed shard order (misses restore or
+        re-derive — the residency-independence contract)."""
+        return [self.cache.ensure(spec.index)[:spec.n_entities]
+                for spec in self.plan.shards]
+
+    # -- compile discipline ------------------------------------------------
+
+    def trace_budgets(self) -> dict:
+        """Per-kernel compile budgets from the OBSERVED geometry: shape
+        buckets, never entity or row counts. Tight enough to catch a
+        per-batch or per-entity retrace, loose enough for the final
+        partial batch's own (rows, n) signature."""
+        geoms = self._geoms or []
+        fb = {(g.rows_bucket, g.nnz_bucket) for g in geoms}
+        fbn = {(g.rows_bucket, g.nnz_bucket, g.n_rows) for g in geoms}
+        gc = {(g.rows_bucket, g.nnz_bucket, g.u_bucket) for g in geoms}
+        rbn = {(g.rows_bucket, g.n_rows) for g in geoms}
+        rb = {g.rows_bucket for g in geoms}
+        ep = {s.e_pad for s in self.plan.shards}
+        sc = {(self.plan.shards[i].e_pad, sr.m_pad)
+              for i, sr in self._shard_rows.items()}
+        return {
+            "mf:gamma": max(1, len(gc)),
+            "mf:gsolve": max(1, len(ep)),
+            "mf:gscatter": max(1, len(sc)),
+            "mf:init": max(1, len(fbn)),
+            "mf:dir": max(1, len(fb)),
+            "mf:grad": max(1, len(fb)),
+            "mf:trial": max(1, 2 * len(rbn)),
+            "mf:axpy": max(1, 2 * len(rb)),
+            "mf:acc": 4,
+            "mf:resadd": max(1, len(rb)),
+        }
+
+    def assert_trace_budget(self) -> None:
+        from photon_ml_tpu.utils.tracing_guard import RetraceError
+
+        budgets = self.trace_budgets()
+        counts = self.guard.counts()
+        over = {name: (c, budgets[name]) for name, c in counts.items()
+                if name in budgets and c > budgets[name]}
+        if over:
+            raise RetraceError(
+                f"streamed-MF kernels exceeded their per-bucket trace "
+                f"budgets: {over}")

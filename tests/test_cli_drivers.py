@@ -743,6 +743,207 @@ def test_spill_flags_require_hbm_budget(tmp_path, rng):
                     "--spill-source", "redecode"])
 
 
+def _write_mf_avro(path, rng, n=240, n_users=9, d=6, k_true=2):
+    """Linear labels with per-entity rank-k_true coefficient structure —
+    the streamed-MF coordinate's training shape (userId in
+    metadataMap)."""
+    b_true = rng.normal(0, 1, (k_true, d))
+    g_true = rng.normal(0, 1, (n_users, k_true))
+    coefs = g_true @ b_true
+    records = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        x = rng.normal(0, 1, d)
+        yv = float(x @ coefs[u] + rng.normal(0, 0.05))
+        records.append({
+            "uid": f"r{i}", "label": yv,
+            "features": [{"name": f"x{j}", "term": None, "value": float(v)}
+                         for j, v in enumerate(x)],
+            "weight": None, "offset": None,
+            "metadataMap": {"userId": f"user{u}"}})
+    path.mkdir(parents=True, exist_ok=True)
+    write_container(path / "part-00000.avro", schemas.TRAINING_EXAMPLE,
+                    records)
+
+
+_MF_STREAM_BASE = [
+    "--task-type", "LINEAR_REGRESSION",
+    "--factored-random-effect-data-configurations",
+    "perUser:userId,global,1,-1,-1,-1,identity",
+    "--factored-random-effect-optimization-configurations",
+    "perUser:20,1e-8,0.001,1.0,LBFGS,L2;20,1e-8,0.001,1.0,LBFGS,L2;2,3",
+    "--updating-sequence", "perUser",
+]
+
+
+def _latent_records(out_dir):
+    """Decoded latent artifacts — the byte-identity comparison unit for
+    MF runs (per-entity gamma + the shared projection B)."""
+    base = out_dir / "best" / "random-effect" / "perUser" / "latent"
+    return (list(read_container(base / "gamma-latent-factors.avro")),
+            list(read_container(base / "projection-latent-factors.avro")))
+
+
+def test_stream_train_mf_identity_across_residency_and_feeder(tmp_path,
+                                                              rng):
+    """Tentpole acceptance at the CLI: a factor table larger than
+    --hbm-budget trains to completion out-of-core, and the saved latent
+    artifacts (gamma + B) are IDENTICAL across residency, feeder and
+    prefetch configs; the streamed model parity-matches the in-core
+    driver's factored coordinate at identical iteration counts."""
+    train = tmp_path / "train"
+    _write_mf_avro(train, rng)
+    base = ["--train-input-dirs", str(train)] + _MF_STREAM_BASE
+
+    resident = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "resident"),
+                "--stream-train", "--batch-rows", "64"])
+    info = resident["stream_train"]
+    assert info["mode"] == "mf-stream"
+    assert info["cache"]["evictions"] == 0
+    g_res, p_res = _latent_records(tmp_path / "resident")
+
+    spill = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "spill"),
+                "--stream-train", "--batch-rows", "64",
+                "--hbm-budget", "64"])
+    cache = spill["stream_train"]["cache"]
+    assert cache["evictions"] > 0 and cache["misses"] > 0
+    # the factor table exceeds the budget: out-of-core by construction
+    assert cache["peak_device_bytes"] + cache["spill_bytes_host"] > 64
+    assert _latent_records(tmp_path / "spill") == (g_res, p_res)
+
+    forced = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "python"),
+                "--stream-train", "--batch-rows", "64",
+                "--feeder", "python", "--prefetch-batches", "0"])
+    assert forced["stream_train"]["feeder"]["decode_path"] == "python"
+    assert _latent_records(tmp_path / "python") == (g_res, p_res)
+
+    # in-core parity at identical iteration counts: the one-shot driver
+    # trains the same factored coordinate through the estimator
+    game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "incore")])
+    g_ic, p_ic = _latent_records(tmp_path / "incore")
+    b_stream = np.asarray([r["latentFactor"] for r in p_res])
+    b_core = np.asarray([r["latentFactor"] for r in p_ic])
+    assert b_stream.shape == b_core.shape
+    scale = np.max(np.abs(b_core))
+    assert np.max(np.abs(b_stream - b_core)) <= 1e-3 * scale
+    assert [r["effectId"] for r in g_res] == [r["effectId"] for r in g_ic]
+
+
+def test_stream_train_mf_bf16_and_redecode_tiers(tmp_path, rng):
+    """Spill tiers for factors at the CLI: bf16 models are bitwise
+    residency-independent and parity-bounded vs f32; redecode keeps
+    ZERO host spill bytes, re-derives misses from observations, and
+    writes bytes identical to the buffer tier."""
+    train = tmp_path / "train"
+    _write_mf_avro(train, rng)
+    base = ["--train-input-dirs", str(train)] + _MF_STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64"]
+
+    f32 = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "f32"),
+                "--hbm-budget", "64"])
+    lat_f32 = _latent_records(tmp_path / "f32")
+
+    bf_small = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "bf-small"),
+                "--hbm-budget", "64", "--spill-dtype", "bf16"])
+    bf_big = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "bf-big"),
+                "--hbm-budget", "1G", "--spill-dtype", "bf16"])
+    assert bf_small["stream_train"]["cache"]["evictions"] > 0
+    assert bf_big["stream_train"]["cache"]["evictions"] == 0
+    lat_small = _latent_records(tmp_path / "bf-small")
+    assert lat_small == _latent_records(tmp_path / "bf-big")
+    assert lat_small != lat_f32  # quantized — but parity-bounded:
+    b_bf = np.asarray([r["latentFactor"] for r in lat_small[1]])
+    b_f = np.asarray([r["latentFactor"] for r in lat_f32[1]])
+    assert np.max(np.abs(b_bf - b_f)) <= 0.05 * np.max(np.abs(b_f))
+
+    rd = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "redecode"),
+                "--hbm-budget", "64", "--spill-source", "redecode"])
+    info = rd["stream_train"]
+    assert info["cache"]["spill_bytes_host"] == 0
+    assert info["cache"]["redecodes"] > 0
+    assert info["redecode"]["payload_bytes_read"] > 0
+    assert info["redecode"]["rows_fetched"] > 0
+    assert _latent_records(tmp_path / "redecode") == lat_f32
+
+
+def test_stream_train_mf_schema_grid_and_compile_bounds(tmp_path, rng):
+    """MF-mode metrics.json schema (snake_case, plan block, ALX density
+    histogram), λ-grid kernel sharing (grid points with one num_factors
+    share every compiled kernel — trace counts within the per-bucket
+    budgets), and factor-cache registry counters."""
+    train = tmp_path / "train"
+    _write_mf_avro(train, rng)
+    # grid: two λ points at k=3 (share one objective/cache) + one at
+    # k=2 (its own cache -> the cache_by_num_factors block)
+    grid = ("perUser:20,1e-8,0.001,1.0,LBFGS,L2;20,1e-8,0.001,1.0,"
+            "LBFGS,L2;2,3|15,1e-8,0.1,1.0,LBFGS,L2;15,1e-8,0.1,1.0,"
+            "LBFGS,L2;2,3|10,1e-8,0.001,1.0,LBFGS,L2;10,1e-8,0.001,"
+            "1.0,LBFGS,L2;1,2")
+    summary = game_training_driver.run([
+        "--train-input-dirs", str(train),
+        "--task-type", "LINEAR_REGRESSION",
+        "--factored-random-effect-data-configurations",
+        "perUser:userId,global,1,-1,-1,-1,identity",
+        "--factored-random-effect-optimization-configurations", grid,
+        "--updating-sequence", "perUser",
+        "--output-dir", str(tmp_path / "out"),
+        "--stream-train", "--batch-rows", "64", "--hbm-budget", "64"])
+    assert summary["numCombos"] == 3
+    info = summary["stream_train"]
+    assert set(info) == {"mode", "batch_rows", "hbm_budget_bytes",
+                         "mesh_devices", "spill_dtype", "spill_source",
+                         "feeder", "cache", "plan", "trace_budgets",
+                         "trace_counts", "cache_by_num_factors"}
+    # every factor cache in a multi-k grid stays observable post-run
+    assert set(info["cache_by_num_factors"]) == {"2", "3"}
+    assert info["cache_by_num_factors"]["3"] == info["cache"]
+    assert info["mode"] == "mf-stream"
+    assert info["mesh_devices"] is None
+    assert info["plan"]["entities"] == 9
+    assert info["plan"]["shards"] >= 1
+    assert sum(info["plan"]["obs_bucket_histogram"].values()) == 9
+    # compile bound: every mf kernel within its observed-bucket budget,
+    # TWO grid points deep (shared objective -> shared executables)
+    for name, count in info["trace_counts"].items():
+        if name in info["trace_budgets"]:
+            assert count <= info["trace_budgets"][name], (name, count)
+    m = summary["telemetry"]["metrics"]
+    assert m["counters"]["data.factor_cache.evictions"] > 0
+    assert m["gauges"]["data.factor_cache.peak_device_bytes"] > 0
+    # mf sweeps rode the solver-iteration telemetry (B refits)
+    assert m["counters"]["training.solver_iterations"] >= 1
+
+
+def test_stream_train_mf_flag_validation(tmp_path, rng):
+    train = tmp_path / "train"
+    _write_mf_avro(train, rng, n=60)
+    base = ["--train-input-dirs", str(train)] + _MF_STREAM_BASE
+    with pytest.raises(ValueError, match="mesh"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "a"), "--stream-train",
+                    "--batch-rows", "32", "--hbm-budget", "8K",
+                    "--mesh-devices", "1"])
+    # a plain random effect still cannot stream-train
+    with pytest.raises(ValueError, match="fixed-effect or factored"):
+        game_training_driver.run([
+            "--train-input-dirs", str(train),
+            "--task-type", "LINEAR_REGRESSION",
+            "--random-effect-data-configurations",
+            "re:userId,global,1,-1,-1,-1",
+            "--random-effect-optimization-configurations",
+            "re:10,1e-7,1.0,1.0,LBFGS,L2",
+            "--updating-sequence", "re",
+            "--output-dir", str(tmp_path / "b"), "--stream-train"])
+
+
 def test_stream_train_mesh_model_identical_across_mesh_sizes(tmp_path,
                                                              rng):
     """Tentpole acceptance: --mesh-devices 1 writes the PR-5

@@ -1,0 +1,292 @@
+"""Factor-table cache tests (data/factor_cache.py): ALX-style pow-2
+observation-count bucketing, replay-aware factor-shard eviction, and the
+f32/bf16/redecode spill tiers re-pointed at MUTABLE factor tables."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.factor_cache import (
+    DeviceFactorCache,
+    FactorSpill,
+    encode_factor_spill,
+    plan_factors,
+    restore_spilled_factors,
+)
+
+
+# ---------------------------------------------------------------------------
+# Planning
+# ---------------------------------------------------------------------------
+
+
+def test_plan_obs_count_pow2_bucketing():
+    """Entities land in next_pow2(count) density classes — including the
+    exact-boundary counts (4 -> class 4, 5 -> class 8)."""
+    vocab = np.asarray([f"e{i}" for i in range(8)])
+    counts = np.asarray([1, 2, 3, 4, 5, 8, 9, 16])
+    plan = plan_factors(vocab, counts, entities_per_shard=64)
+    cls_of = {}
+    for s in plan.shards:
+        for c in s.codes:
+            cls_of[int(c)] = s.obs_bucket
+    assert [cls_of[i] for i in range(8)] == [1, 2, 4, 4, 8, 8, 16, 16]
+    # deterministic: same inputs -> same shard list
+    plan2 = plan_factors(vocab, counts, entities_per_shard=64)
+    assert [tuple(s.codes) for s in plan2.shards] == \
+        [tuple(s.codes) for s in plan.shards]
+
+
+def test_plan_shard_boundary_splits_and_epad():
+    """Entity counts straddling the entities_per_shard boundary split
+    into multiple pow-2-padded shards; e_pad respects the minimum."""
+    vocab = np.asarray([f"e{i:02d}" for i in range(9)])
+    counts = np.full(9, 4)  # one class
+    plan = plan_factors(vocab, counts, entities_per_shard=4,
+                        min_entities_pad=8)
+    sizes = [s.n_entities for s in plan.shards]
+    assert sizes == [4, 4, 1]
+    assert [s.e_pad for s in plan.shards] == [8, 8, 8]
+    # exactly at the boundary: no ghost shard
+    plan8 = plan_factors(vocab[:8], counts[:8], entities_per_shard=4)
+    assert [s.n_entities for s in plan8.shards] == [4, 4]
+    # pow-2 pad grows past the minimum
+    plan_big = plan_factors(
+        np.asarray([f"x{i:03d}" for i in range(21)]), np.full(21, 2),
+        entities_per_shard=64, min_entities_pad=8)
+    assert [s.e_pad for s in plan_big.shards] == [32]
+
+
+def test_plan_roundtrip_and_zero_count():
+    vocab = np.asarray(["a", "b", "c", "zero"])
+    counts = np.asarray([3, 1, 7, 0])
+    plan = plan_factors(vocab, counts, entities_per_shard=2)
+    # every code maps to a (shard, slot) that maps back
+    for code in range(4):
+        s = plan.shards[plan.shard_of_code[code]]
+        assert s.codes[plan.slot_of_code[code]] == code
+    # zero-observation entities ride the smallest class (solvable to 0)
+    zero_code = int(np.flatnonzero(vocab == "zero")[0])
+    assert plan.shards[plan.shard_of_code[zero_code]].obs_bucket == 1
+    # name join: unknown -> -1
+    assert list(plan.codes_of(np.asarray(["c", "nope"]))) == [2, -1]
+    assert sum(plan.obs_bucket_histogram().values()) == 4
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError, match="entities_per_shard"):
+        plan_factors(np.asarray(["a"]), np.asarray([1]),
+                     entities_per_shard=0)
+    with pytest.raises(ValueError, match="counts"):
+        plan_factors(np.asarray(["a", "b"]), np.asarray([1]))
+
+
+# ---------------------------------------------------------------------------
+# Spill codec
+# ---------------------------------------------------------------------------
+
+
+def test_factor_spill_f32_roundtrip_bitwise(rng):
+    g = rng.normal(0, 1, (8, 3)).astype(np.float32)
+    spill = encode_factor_spill(g, "f32")
+    assert spill.dtype_tag == "f32" and spill.nbytes == g.nbytes
+    out = np.asarray(restore_spilled_factors(spill))
+    assert out.tobytes() == g.tobytes()
+
+
+def test_factor_spill_bf16_half_bytes_and_lossless_on_quantized(rng):
+    import ml_dtypes
+
+    g = rng.normal(0, 1, (16, 4)).astype(np.float32)
+    # the cache quantizes at write; a quantized table round-trips exactly
+    gq = g.astype(ml_dtypes.bfloat16).astype(np.float32)
+    spill = encode_factor_spill(gq, "bf16")
+    assert spill.nbytes == gq.nbytes // 2
+    out = np.asarray(restore_spilled_factors(spill))
+    assert out.tobytes() == gq.tobytes()
+    # and the quantization error is the documented bf16 bound
+    assert np.max(np.abs(gq - g)) <= 2.0 ** -8 * np.max(np.abs(g))
+
+
+def test_factor_spill_validation():
+    with pytest.raises(ValueError, match="spill_dtype"):
+        encode_factor_spill(np.zeros((2, 2), np.float32), "f16")
+
+
+# ---------------------------------------------------------------------------
+# Cache residency
+# ---------------------------------------------------------------------------
+
+
+def _plan(n_shards=4, e_pad=8):
+    vocab = np.asarray([f"e{i:02d}" for i in range(n_shards * 4)])
+    counts = np.full(len(vocab), 2)
+    plan = plan_factors(vocab, counts, entities_per_shard=4,
+                        min_entities_pad=e_pad)
+    assert plan.n_shards == n_shards
+    return plan
+
+
+def _fill(cache, k=2, seed=0):
+    rng = np.random.default_rng(seed)
+    raw = []
+    for s in cache.plan.shards:
+        g = rng.normal(0, 1, (s.e_pad, k)).astype(np.float32)
+        raw.append(np.asarray(cache.write(s.index, g)))
+    return raw
+
+
+def test_cache_resident_write_read_and_stats():
+    cache = DeviceFactorCache(_plan(), num_factors=2)
+    raw = _fill(cache)
+    for i, g in enumerate(raw):
+        assert np.asarray(cache.ensure(i)).tobytes() == g.tobytes()
+    st = cache.stats()
+    assert st["hits"] == 4 and st["misses"] == 0 and st["evictions"] == 0
+    assert st["resident_shards"] == 4 and st["spill_bytes_host"] == 0
+    assert st["device_bytes"] == 4 * (4 * 8 * 2)
+    assert set(st) >= {"hits", "misses", "evictions", "bytes_reuploaded",
+                       "spill_bytes_written", "redecodes", "shards",
+                       "entities", "num_factors", "e_pad_buckets",
+                       "obs_bucket_histogram", "hbm_budget_bytes",
+                       "device_bytes", "peak_device_bytes", "spill_dtype",
+                       "spill_source", "spill_bytes_host",
+                       "resident_shards"}
+
+
+def test_cache_read_before_write_raises():
+    cache = DeviceFactorCache(_plan(), num_factors=2)
+    with pytest.raises(RuntimeError, match="never written"):
+        cache.ensure(0)
+
+
+def test_cache_replay_aware_eviction_and_f32_bitwise_restore():
+    """Budget for 2 of 4 shards: the write sequence 0..3 keeps a
+    sensible resident set under the furthest-next-use rule, misses
+    restore the EXACT evicted bytes, and the in-hand shard is never
+    evicted."""
+    shard_bytes = 4 * 8 * 2
+    cache = DeviceFactorCache(_plan(), num_factors=2,
+                              hbm_budget_bytes=2 * shard_bytes)
+    raw = _fill(cache)
+    st = cache.stats()
+    assert st["evictions"] >= 2
+    assert st["resident_shards"] == 2
+    assert st["spill_bytes_host"] > 0
+    # a full fixed-order read epoch restores everything bitwise
+    for i, g in enumerate(raw):
+        assert np.asarray(cache.ensure(i)).tobytes() == g.tobytes()
+    st = cache.stats()
+    assert st["misses"] >= 2 and st["bytes_reuploaded"] > 0
+    assert cache.device_bytes <= 2 * shard_bytes
+    # one-shard budget: the pinned write always survives
+    tiny = DeviceFactorCache(_plan(), num_factors=2, hbm_budget_bytes=1)
+    raws = _fill(tiny)
+    assert tiny.stats()["resident_shards"] == 1
+    assert np.asarray(tiny.ensure(3)).tobytes() == raws[3].tobytes()
+
+
+def test_cache_rewrite_drops_stale_spill():
+    """Factors mutate per sweep: a re-write supersedes the old spill
+    record and the next miss restores the NEW bytes."""
+    shard_bytes = 4 * 8 * 2
+    cache = DeviceFactorCache(_plan(), num_factors=2,
+                              hbm_budget_bytes=2 * shard_bytes)
+    _fill(cache, seed=0)
+    raw2 = _fill(cache, seed=1)  # second sweep's writes
+    for i, g in enumerate(raw2):
+        assert np.asarray(cache.ensure(i)).tobytes() == g.tobytes()
+
+
+def test_cache_bf16_quantizes_at_write_residency_independent(rng):
+    """bf16 is applied AT WRITE, evicted or not: the returned canonical
+    table equals the bf16 round trip, restores are bitwise the resident
+    copy, and spill records are half the f32 bytes."""
+    import ml_dtypes
+
+    g = rng.normal(0, 1, (8, 2)).astype(np.float32)
+    gq = g.astype(ml_dtypes.bfloat16).astype(np.float32)
+    shard_bytes = 4 * 8 * 2
+    resident = DeviceFactorCache(_plan(), num_factors=2,
+                                 spill_dtype="bf16",
+                                 hbm_budget_bytes=10 ** 9)
+    evicting = DeviceFactorCache(_plan(), num_factors=2,
+                                 spill_dtype="bf16",
+                                 hbm_budget_bytes=shard_bytes)
+    for cache in (resident, evicting):
+        out = np.asarray(cache.write(0, g))
+        assert out.tobytes() == gq.tobytes()
+        for s in cache.plan.shards[1:]:
+            cache.write(s.index, g)
+    assert evicting.stats()["evictions"] > 0
+    assert resident.stats()["evictions"] == 0
+    for i in range(4):
+        a = np.asarray(resident.ensure(i))
+        b = np.asarray(evicting.ensure(i))
+        assert a.tobytes() == b.tobytes() == gq.tobytes()
+    assert evicting.stats()["spill_bytes_written"] > 0
+    # bf16 spill records are half of the f32 table bytes
+    spilled = [e for e in evicting.entries if e.spill is not None]
+    for e in spilled:
+        assert e.spill.nbytes == e.factor_bytes // 2
+
+
+def test_cache_redecode_tier_rederives_and_keeps_no_host_bytes(rng):
+    g0 = rng.normal(0, 1, (8, 2)).astype(np.float32)
+    calls = []
+
+    def rederive(index):
+        calls.append(index)
+        return jnp.asarray(g0 + np.float32(index))
+
+    shard_bytes = 4 * 8 * 2
+    cache = DeviceFactorCache(_plan(), num_factors=2,
+                              spill_source="redecode",
+                              hbm_budget_bytes=shard_bytes,
+                              redecode=rederive)
+    for s in cache.plan.shards:
+        cache.write(s.index, g0 + np.float32(s.index))
+    assert cache.stats()["evictions"] == 3
+    assert cache.stats()["spill_bytes_host"] == 0
+    for i in range(4):
+        out = np.asarray(cache.ensure(i))
+        assert out.tobytes() == (g0 + np.float32(i)).tobytes()
+    # capacity-1 residency: every read in the epoch is a re-derivation
+    assert cache.stats()["redecodes"] == len(calls) == 4
+    assert cache.stats()["spill_bytes_host"] == 0
+
+
+def test_cache_redecode_without_hook_raises():
+    cache = DeviceFactorCache(_plan(), num_factors=2,
+                              spill_source="redecode",
+                              hbm_budget_bytes=1)
+    _fill(cache)
+    with pytest.raises(RuntimeError, match="no spill record"):
+        cache.ensure(0)
+
+
+def test_cache_validation():
+    plan = _plan()
+    with pytest.raises(ValueError, match="pick one"):
+        DeviceFactorCache(plan, 2, spill_dtype="bf16",
+                          spill_source="redecode")
+    with pytest.raises(ValueError, match="spill_dtype"):
+        DeviceFactorCache(plan, 2, spill_dtype="f64")
+    with pytest.raises(ValueError, match="spill_source"):
+        DeviceFactorCache(plan, 2, spill_source="disk")
+    with pytest.raises(ValueError, match="num_factors"):
+        DeviceFactorCache(plan, 0)
+    cache = DeviceFactorCache(plan, 2)
+    with pytest.raises(ValueError, match="shape"):
+        cache.write(0, np.zeros((4, 2), np.float32))
+
+
+def test_restore_spilled_factors_is_the_blessed_path(rng):
+    """Direct FactorSpill construction + restore agree with the
+    encode path (the codec's two halves cannot diverge)."""
+    g = rng.normal(0, 1, (8, 2)).astype(np.float32)
+    direct = FactorSpill(enc=g.copy(), dtype_tag="f32")
+    assert np.asarray(restore_spilled_factors(direct)).tobytes() == \
+        np.asarray(restore_spilled_factors(
+            encode_factor_spill(g, "f32"))).tobytes()

@@ -1,0 +1,402 @@
+"""Streamed MF alternating-least-squares tests (ops/mf_alternating.py +
+algorithm StreamingFactoredRandomEffectCoordinate): out-of-core factor
+tables with model bytes independent of residency/feeder config,
+parity-bounded against the in-core FactoredRandomEffectCoordinate, and
+typed divergence faults."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+from photon_ml_tpu.algorithm import (
+    FactoredRandomEffectCoordinate,
+    StreamingFactoredRandomEffectCoordinate,
+)
+from photon_ml_tpu.data.factor_cache import (
+    DeviceFactorCache,
+    plan_factors,
+)
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.random_effect import (
+    RandomEffectDataConfiguration,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.models import FactoredRandomEffectModel
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.mf_alternating import StreamedMFObjective
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    MFOptimizationConfiguration,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_ml_tpu.optimization.convergence import SolverDivergedError
+from photon_ml_tpu.types import TaskType
+
+_L2 = RegularizationContext(RegularizationType.L2)
+
+
+def _glm_cfg(**kw):
+    kwargs = dict(max_iterations=25, tolerance=1e-8,
+                  regularization_weight=1e-3, regularization_context=_L2)
+    kwargs.update(kw)
+    return GLMOptimizationConfiguration(**kwargs)
+
+
+def _problem(rng, n=400, d=10, n_users=12, k_true=2, noise=0.05):
+    x = rng.normal(0, 1, (n, d))
+    users = rng.integers(0, n_users, n)
+    coefs = rng.normal(0, 1.0, (n_users, k_true)) \
+        @ rng.normal(0, 1, (k_true, d))
+    y = np.einsum("nd,nd->n", x, coefs[users]) + rng.normal(0, noise, n)
+    names = np.asarray([f"u{u:02d}" for u in users])
+    return x, y, names
+
+
+def _batches(x, y, names, rows=96):
+    out = []
+    for a in range(0, len(y), rows):
+        b = min(a + rows, len(y))
+        out.append(GameDataset.build(
+            responses=y[a:b],
+            feature_shards={"s": sp.csr_matrix(x[a:b])},
+            ids={"userId": names[a:b]}))
+    return out
+
+
+def _coord(x, y, names, rows=96, **kw):
+    kwargs = dict(
+        name="mf", make_stream=lambda: iter(_batches(x, y, names, rows)),
+        feature_shard_id="s", random_effect_type="userId",
+        task_type=TaskType.LINEAR_REGRESSION,
+        config=_glm_cfg(), latent_config=_glm_cfg(),
+        mf_config=MFOptimizationConfiguration(max_iterations=2,
+                                              num_factors=2),
+        entities_per_shard=5)
+    kwargs.update(kw)
+    return StreamingFactoredRandomEffectCoordinate(**kwargs)
+
+
+def _model_bytes(m):
+    return (b"".join(np.asarray(c).tobytes()
+                     for c in m.latent.local_coefs)
+            + np.asarray(m.projection_matrix).tobytes())
+
+
+def test_streamed_mf_learns_low_rank_structure(rng):
+    x, y, names = _problem(rng)
+    coord = _coord(x, y, names)
+    model = coord.initialize_model()
+    assert isinstance(model, FactoredRandomEffectModel)
+    s0 = np.asarray(coord.score(model))
+    model, trackers = coord.solve(model)
+    s1 = np.asarray(coord.score(model))
+    assert len(trackers) == 2  # one OptimizerResult per sweep
+    loss0 = float(np.mean((s0 - y) ** 2))
+    loss1 = float(np.mean((s1 - y) ** 2))
+    assert loss1 < 0.1 * loss0, (loss0, loss1)
+    # model assembly: true entity counts, codes into the plan vocab
+    assert model.latent.num_entities == len(set(names))
+    assert model.projection_matrix.shape == (2, x.shape[1])
+
+
+def test_streamed_parity_bounded_vs_in_core(rng):
+    """Same data, same iteration counts, same seeded B0: the streamed
+    ALS (exact ridge gamma solves + streamed L-BFGS refit) and the
+    in-core coordinate (vmapped L-BFGS gamma solves + fused refit)
+    converge to the same strictly convex alternating optimum — scores
+    agree to a tight relative bound."""
+    x, y, names = _problem(rng)
+    coord = _coord(x, y, names)
+    model, _ = coord.solve()
+    s_stream = np.asarray(coord.score(model))
+
+    data = GameDataset.build(
+        responses=y, feature_shards={"s": sp.csr_matrix(x)},
+        ids={"userId": names})
+    ds = build_random_effect_dataset(
+        data, RandomEffectDataConfiguration("userId", "s",
+                                            projector_type="IDENTITY"))
+    in_core = FactoredRandomEffectCoordinate(
+        name="mf", dataset=ds, task_type=TaskType.LINEAR_REGRESSION,
+        config=_glm_cfg(), latent_config=_glm_cfg(),
+        mf_config=MFOptimizationConfiguration(max_iterations=2,
+                                              num_factors=2))
+    icm, _ = in_core.update_model(in_core.initialize_model(), None,
+                                  jax.random.key(0))
+    s_core = np.asarray(in_core.score(icm))
+    scale = np.max(np.abs(s_core))
+    assert np.max(np.abs(s_stream - s_core)) <= 1e-3 * scale, \
+        np.max(np.abs(s_stream - s_core)) / scale
+    # and the streamed host-scoring path agrees with the coordinate's
+    np.testing.assert_allclose(model.score_numpy(data), s_stream,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_model_bytes_identical_across_residency_and_batching(rng):
+    """The tentpole acceptance: a factor table larger than the budget
+    trains out-of-core with model bytes IDENTICAL to the fully
+    resident run (f32 spill bitwise), for eviction-forced budgets and
+    across batch cuts that straddle bucket boundaries."""
+    x, y, names = _problem(rng)
+    base, _ = _coord(x, y, names).solve()
+
+    tight = _coord(x, y, names, hbm_budget_bytes=48)
+    m_tight, _ = tight.solve()
+    st = tight.cache.stats()
+    assert st["evictions"] > 0 and st["misses"] > 0
+    # the factor table exceeds the budget — out-of-core by construction
+    total_factor_bytes = sum(4 * s.e_pad * 2 for s in tight.plan.shards)
+    assert total_factor_bytes > 48
+    assert _model_bytes(m_tight) == _model_bytes(base)
+
+    tiny = _coord(x, y, names, hbm_budget_bytes=1)
+    m_tiny, _ = tiny.solve()
+    assert _model_bytes(m_tiny) == _model_bytes(base)
+
+
+def test_model_bytes_identical_across_stream_batch_rows(rng):
+    """Different --batch-rows cuts re-bucket the OBSERVATION stream.
+
+    The gamma normal equations accumulate per batch in f32, so the cut
+    changes the summation association — bytes are not bitwise across
+    batch sizes (same as the sharded GLM fold vs the one-shot path) —
+    but the solve must stay deterministic per cut and parity-close
+    across cuts."""
+    x, y, names = _problem(rng)
+    a1, _ = _coord(x, y, names, rows=96).solve()
+    a2, _ = _coord(x, y, names, rows=96).solve()
+    assert _model_bytes(a1) == _model_bytes(a2)  # per-cut determinism
+    b1, _ = _coord(x, y, names, rows=57).solve()
+    np.testing.assert_allclose(
+        np.asarray(a1.projection_matrix), np.asarray(b1.projection_matrix),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_bf16_factors_residency_independent_and_parity_bounded(rng):
+    x, y, names = _problem(rng)
+    base, _ = _coord(x, y, names).solve()
+    resident = _coord(x, y, names, hbm_budget_bytes=10 ** 9,
+                      spill_dtype="bf16")
+    m_res, _ = resident.solve()
+    evicting = _coord(x, y, names, hbm_budget_bytes=48,
+                      spill_dtype="bf16")
+    m_ev, _ = evicting.solve()
+    assert evicting.cache.stats()["evictions"] > 0
+    assert resident.cache.stats()["evictions"] == 0
+    # two budgets with totally different eviction pressure: same bytes
+    assert _model_bytes(m_res) == _model_bytes(m_ev)
+    # quantized models differ from f32 only within the bf16 bound
+    assert _model_bytes(m_res) != _model_bytes(base)
+    b_f32 = np.asarray(base.projection_matrix)
+    b_bf = np.asarray(m_res.projection_matrix)
+    assert np.max(np.abs(b_bf - b_f32)) <= 0.05 * np.max(np.abs(b_f32))
+
+
+def test_redecode_tier_bitwise_and_no_host_bytes(rng):
+    """redecode factors: evicted shards keep NO host copy; misses
+    re-derive from re-decoded observations bit-for-bit the buffer-tier
+    bytes (the gamma solve is a pure function of (observations, B))."""
+    x, y, names = _problem(rng)
+    buf = _coord(x, y, names, hbm_budget_bytes=48)
+    m_buf, _ = buf.solve()
+    rd = _coord(x, y, names, hbm_budget_bytes=48,
+                spill_source="redecode")
+    m_rd, _ = rd.solve()
+    st = rd.cache.stats()
+    assert st["redecodes"] > 0
+    assert st["spill_bytes_host"] == 0
+    assert _model_bytes(m_rd) == _model_bytes(m_buf)
+
+
+def test_feeder_variant_streams_identical_bytes(rng):
+    """Any deterministic replayable stream with the same batch cuts
+    writes the same bytes — the coordinate-level analog of the CLI's
+    native-vs-python feeder identity (pinned end-to-end in
+    tests/test_cli_drivers.py)."""
+    x, y, names = _problem(rng)
+    a, _ = _coord(x, y, names).solve()
+
+    def generator_stream():
+        # a lazy generator instead of a list iterator: different
+        # producer, same batches
+        for ds in _batches(x, y, names, 96):
+            yield ds
+
+    b, _ = _coord(x, y, names, make_stream=generator_stream).solve()
+    assert _model_bytes(a) == _model_bytes(b)
+
+
+def test_residual_scores_shift_solution_and_fold_into_offsets(rng):
+    """The coordinate-descent residual contract: residual scores act as
+    extra offsets in BOTH half-steps, and clearing them restores the
+    base solution bitwise."""
+    x, y, names = _problem(rng)
+    coord = _coord(x, y, names)
+    base, _ = coord.solve()
+    res = np.linspace(-2.0, 2.0, len(y)).astype(np.float32)
+    shifted, _ = coord.solve(residual_scores=res)
+    assert _model_bytes(shifted) != _model_bytes(base)
+    again, _ = coord.solve(residual_scores=None)
+    assert _model_bytes(again) == _model_bytes(base)
+    # residual-as-offset equivalence: solving against residual r is the
+    # same objective as training on labels y - r (both half-steps see
+    # t = y - off - r), so the two solutions agree to fp association
+    direct = _coord(x, y - np.asarray(res, np.float64), names)
+    m_direct, _ = direct.solve()
+    np.testing.assert_allclose(
+        np.asarray(shifted.projection_matrix),
+        np.asarray(m_direct.projection_matrix), rtol=1e-3, atol=1e-4)
+
+
+def test_zero_observation_entities_solve_to_zero(rng):
+    """Entities planned but never observed (e.g. from a stale vocab)
+    get exactly-zero factors — the ridge normal equations with
+    A = 0, b = 0 — and survive the whole pipeline."""
+    x, y, names = _problem(rng, n=200, n_users=6)
+    vocab = np.asarray(sorted(set(names) | {"zz-never-seen-1",
+                                            "zz-never-seen-2"}))
+    counts = np.asarray([int((names == v).sum()) for v in vocab])
+    assert (counts == 0).sum() == 2
+    plan = plan_factors(vocab, counts, entities_per_shard=4)
+    cache = DeviceFactorCache(plan, 2)
+    obj = StreamedMFObjective(
+        lambda: iter(_batches(x, y, names, 96)), "s", "userId", plan,
+        cache, x.shape[1], loss_for_task(TaskType.LINEAR_REGRESSION))
+    b0 = rng.normal(0, 0.5, (2, x.shape[1])).astype(np.float32)
+    obj.gamma_pass(b0, 1e-3)
+    for name in ("zz-never-seen-1", "zz-never-seen-2"):
+        code = int(np.flatnonzero(vocab == name)[0])
+        shard = int(plan.shard_of_code[code])
+        slot = int(plan.slot_of_code[code])
+        g = np.asarray(cache.ensure(shard))
+        assert np.all(g[slot] == 0.0)
+
+
+def test_entity_counts_straddling_bucket_boundaries(rng):
+    """Entity populations at/over the pow-2 pad and shard-split
+    boundaries train and keep byte-identity across residency."""
+    for n_users in (4, 5, 8, 9):
+        x, y, names = _problem(rng, n=260, n_users=n_users)
+        a, _ = _coord(x, y, names, entities_per_shard=4).solve()
+        b, _ = _coord(x, y, names, entities_per_shard=4,
+                      hbm_budget_bytes=32).solve()
+        assert _model_bytes(a) == _model_bytes(b), n_users
+        assert a.latent.num_entities == len(set(names))
+
+
+def test_unknown_entities_at_scoring_time_after_streamed_train(rng):
+    """A streamed-MF-trained model scores datasets containing unknown
+    entities with ZERO contribution for them — via the host model path
+    AND the serving engine (the PR-2 unknown-entity join semantics)."""
+    x, y, names = _problem(rng)
+    coord = _coord(x, y, names)
+    model, _ = coord.solve()
+
+    x_new = rng.normal(0, 1, (4, x.shape[1]))
+    mixed = GameDataset.build(
+        responses=np.zeros(4),
+        feature_shards={"s": sp.csr_matrix(x_new)},
+        ids={"userId": np.asarray([names[0], "brand-new-entity",
+                                   names[1], "another-new-one"])})
+    host = np.asarray(model.score_numpy(mixed))
+    assert host[1] == 0.0 and host[3] == 0.0
+    assert host[0] != 0.0 and host[2] != 0.0
+
+    from photon_ml_tpu.models.game_model import GameModel
+    from photon_ml_tpu.serving import StreamingGameScorer
+
+    engine = StreamingGameScorer(
+        GameModel({"mf": model}, TaskType.LINEAR_REGRESSION))
+    dev = np.asarray(engine.score(mixed))
+    np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_divergence_watchdog_raises_typed_error(rng):
+    """A NaN observation poisons the alternating solve: the per-sweep
+    watchdog (check_solver_finite, shared with the streamed L-BFGS/TRON
+    paths) raises a typed SolverDivergedError instead of silently
+    writing a NaN model."""
+    x, y, names = _problem(rng)
+    y_bad = y.copy()
+    y_bad[7] = np.nan
+    coord = _coord(x, y_bad, names)
+    with pytest.raises(SolverDivergedError) as ei:
+        coord.solve()
+    assert ei.value.iteration >= 0
+    assert not np.isfinite(ei.value.value) \
+        or not np.isfinite(ei.value.grad_norm)
+
+
+def test_compile_counts_bounded_by_buckets_and_shared_across_grid(
+        rng, tracing_guard):
+    """Compile discipline: kernel traces stay within the
+    observed-geometry budgets (bucket counts, never entity counts), a
+    λ-grid point sharing the objective adds NO new traces, and a
+    DIFFERENT entity population with the same bucket shapes reuses the
+    same executables."""
+    x, y, names = _problem(rng)
+    coord = _coord(x, y, names, tracing_guard=tracing_guard)
+    coord.solve()
+    obj = coord.mf_objective
+    obj.assert_trace_budget()
+    counts_after_first = dict(obj.guard.counts())
+
+    # λ-grid sharing: a second coordinate over the SAME objective (the
+    # driver's grid loop) must not retrace anything.
+    coord2 = _coord(x, y, names,
+                    config=_glm_cfg(regularization_weight=0.1),
+                    latent_config=_glm_cfg(regularization_weight=0.1),
+                    mf_objective=obj)
+    coord2.solve()
+    assert obj.guard.counts() == counts_after_first
+    obj.assert_trace_budget()
+    for name, budget in obj.trace_budgets().items():
+        tracing_guard.set_budget  # fixture verifies at teardown
+        assert obj.guard.counts().get(name, 0) <= budget
+
+
+def test_scope_enforcement_errors(rng):
+    x, y, names = _problem(rng, n=120, n_users=4)
+
+    def make(**kw):
+        return _coord(x, y, names, **kw)
+
+    with pytest.raises(ValueError, match="LINEAR_REGRESSION"):
+        make(task_type=TaskType.LOGISTIC_REGRESSION)
+    with pytest.raises(ValueError, match="L2 only"):
+        make(config=_glm_cfg(regularization_context=RegularizationContext(
+            RegularizationType.L1)))
+    with pytest.raises(ValueError, match="positive gamma L2"):
+        make(config=_glm_cfg(regularization_weight=0.0))
+    with pytest.raises(ValueError, match="LBFGS"):
+        make(latent_config=_glm_cfg(optimizer_type=OptimizerType.TRON))
+    with pytest.raises(ValueError, match="down-sampling"):
+        make(config=_glm_cfg(down_sampling_rate=0.5))
+    # shared-objective k mismatch fails loudly
+    base = make()
+    with pytest.raises(ValueError, match="num_factors"):
+        make(mf_config=MFOptimizationConfiguration(max_iterations=2,
+                                                   num_factors=3),
+             mf_objective=base.mf_objective)
+
+
+def test_stream_mutation_fails_loudly(rng):
+    """The input changing under the objective (different batch shapes
+    between passes) is a hard error, not silent corruption."""
+    x, y, names = _problem(rng, n=200, n_users=6)
+    calls = {"n": 0}
+
+    def unstable_stream():
+        # calls 1-2 are the planning + geometry passes; the cut changes
+        # under the objective from the first FEATURE pass on
+        calls["n"] += 1
+        rows = 96 if calls["n"] <= 2 else 64
+        return iter(_batches(x, y, names, rows))
+
+    coord = _coord(x, y, names, make_stream=unstable_stream)
+    with pytest.raises(RuntimeError, match="changed under"):
+        coord.solve()

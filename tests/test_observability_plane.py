@@ -182,6 +182,43 @@ def test_stream_train_obs_heartbeat(tmp_path, rng):
     assert "solve" in stages and "ingest" in stages
 
 
+def test_stream_train_mf_factor_cache_statusz_provider(tmp_path, rng):
+    """The streamed-MF factor cache registers as a live /statusz
+    provider: hits/misses/evictions/spill bytes are scrapeable WHILE an
+    MF train runs under --hbm-budget (mirroring the fixed-effect
+    shard-cache provider)."""
+    from tests.test_cli_drivers import _MF_STREAM_BASE, _write_mf_avro
+
+    train = tmp_path / "train"
+    _write_mf_avro(train, rng, n=240)
+    out = tmp_path / "mf-out-obs"
+    out.mkdir()
+    results = {}
+    scraper = threading.Thread(
+        target=_scrape_while_alive, args=(out, results), daemon=True)
+    scraper.start()
+    summary = game_training_driver.run(
+        ["--train-input-dirs", str(train)] + _MF_STREAM_BASE + [
+            "--output-dir", str(out),
+            "--stream-train", "--batch-rows", "64",
+            "--hbm-budget", "64", "--obs-port", "0"])
+    scraper.join(timeout=60)
+    assert "error" not in results
+    assert results.get("scrapes", 0) >= 1
+    parse_prometheus(results["metrics"])  # valid exposition, live
+    statusz = json.loads(results["statusz"])
+    fc = statusz["status"].get("factor_cache")
+    assert fc is not None, sorted(statusz["status"])
+    for key in ("hits", "misses", "evictions", "spill_bytes_host",
+                "resident_shards", "hbm_budget_bytes"):
+        assert key in fc, key
+    assert fc["hbm_budget_bytes"] == 64
+    assert summary["stream_train"]["mode"] == "mf-stream"
+    assert summary["stream_train"]["cache"]["evictions"] > 0
+    # sweeps landed on the trace tail (one TraceContext per sweep)
+    assert summary["observability"]["trace_tail"]["seen"] >= 2
+
+
 @pytest.mark.needs_f64
 def test_scoring_metrics_json_includes_new_frontend_keys(tmp_path, rng):
     """The per-model admission view is part of the stats()/statusz
