@@ -39,6 +39,15 @@ Scope and mechanics:
   declared exemplar-bearing at one site and plain at another (the
   registry is get-or-create — whichever call runs first would silently
   win).
+- GAUGE-ONLY metric families (docs/OBSERVABILITY.md §Distributions &
+  drift): names under ``data.dist.`` (distribution-sketch headline
+  values, refreshed whole by scrape hooks) and names containing
+  ``score_drift_`` (the ``serving.model.<label>.score_drift_psi``/
+  ``_ks`` drift scores, COMPUTED on scrape) are instantaneous readings
+  by construction — a counter or histogram under either family would
+  break the ``--slo`` value-objective contract and every dashboard
+  rate() built on the family. Checked on full literals AND on literal
+  fragments of partially-dynamic names (the per-model f-string form).
 
 Exit 0 = clean. Run via tests.sh or directly:
     python dev_scripts/metric_names.py [--root DIR] [paths...]
@@ -57,6 +66,31 @@ DEFAULT_PATHS = ["photon_ml_tpu", "bench.py"]
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)*$")
 _FRAGMENT_BAD_RE = re.compile(r"[^a-z0-9_.]")
+
+#: (trigger, match) -> gauge-only family. ``prefix`` triggers on a name
+#: (or fragment) starting with the string; ``contains`` anywhere in it.
+_GAUGE_ONLY_FAMILIES = (
+    ("prefix", "data.dist.", "the data.dist.* distribution family"),
+    ("contains", "score_drift_",
+     "the serving.model.<label>.score_drift_* drift family"),
+)
+
+
+def _gauge_only_family(text: str, is_fragment: bool):
+    """The gauge-only family ``text`` (a full literal name, or one
+    literal fragment of a partially-dynamic name) belongs to, if any.
+    Prefix families stay prefix-anchored even on fragments (an
+    f-string in the family starts with the literal prefix, e.g.
+    f"data.dist.{col}") — a fragment merely CONTAINING the prefix
+    mid-name (".metadata.dist.errors") is a different namespace."""
+    for mode, needle, label in _GAUGE_ONLY_FAMILIES:
+        if mode == "prefix":
+            hit = text.startswith(needle)
+        else:
+            hit = needle in text
+        if hit:
+            return label
+    return None
 
 
 def _telemetry_bare_names(tree: ast.AST) -> set:
@@ -146,6 +180,15 @@ def check_file(path: Path, src: str, registrations: dict) -> list:
                         "bearing histograms carry trace_id latency "
                         "exemplars and must end in '_seconds' "
                         "(docs/OBSERVABILITY.md §Exemplars)"))
+                family = _gauge_only_family(name, is_fragment=False)
+                if family is not None and kind != "gauge":
+                    out.append((
+                        path, node.lineno, "gauge-only-family",
+                        f"{kind}({name!r}): {family} is gauge-only — "
+                        "distribution/drift values are instantaneous "
+                        "readings refreshed on scrape "
+                        "(docs/OBSERVABILITY.md §Distributions & "
+                        "drift)"))
                 prev = registrations.setdefault(name, {})
                 prev.setdefault(kind, (path, node.lineno))
                 if exemplars is not None:
@@ -164,6 +207,17 @@ def check_file(path: Path, src: str, registrations: dict) -> list:
                         f"{kind}(...{frag!r}...): literal fragment "
                         f"contains {m.group(0)!r} — metric names are "
                         "lowercase [a-z0-9_.] only"))
+                    break
+            for frag in frags:
+                family = _gauge_only_family(frag, is_fragment=True)
+                if family is not None and kind != "gauge":
+                    out.append((
+                        path, node.lineno, "gauge-only-family",
+                        f"{kind}(...{frag!r}...): {family} is "
+                        "gauge-only — distribution/drift values are "
+                        "instantaneous readings refreshed on scrape "
+                        "(docs/OBSERVABILITY.md §Distributions & "
+                        "drift)"))
                     break
     return out
 

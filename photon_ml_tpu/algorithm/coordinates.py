@@ -374,12 +374,16 @@ class StreamingFixedEffectCoordinate:
             self.feature_shard_id)
 
     def solve(self, model: Optional[FixedEffectModel] = None,
-              trace_ctx=None) -> Tuple[FixedEffectModel, OptimizerResult]:
+              trace_ctx=None, convergence_ring=None, margins_out=None
+              ) -> Tuple[FixedEffectModel, OptimizerResult]:
         """One full-batch GLM solve by streamed accumulation (warm-started
         from ``model`` when given). ``trace_ctx`` — the solve's trace
         context (telemetry/tracectx.py; the streaming driver mints one
         per λ-grid point), threaded into the host-driven solver for
-        per-iteration events and divergence-watchdog tagging."""
+        per-iteration events and divergence-watchdog tagging.
+        ``convergence_ring`` / ``margins_out`` — the ``--distmon``
+        distribution-observability hooks, threaded through to the
+        host-driven solvers (see ``minimize_lbfgs_glm_streaming``)."""
         from photon_ml_tpu.optimization.config import OptimizerType
         from photon_ml_tpu.optimization.glm_lbfgs import (
             minimize_lbfgs_glm_streaming,
@@ -397,12 +401,16 @@ class StreamingFixedEffectCoordinate:
             result = minimize_tron_streaming(
                 self._sharded, coef0, self._l2,
                 max_iter=self.config.max_iterations,
-                tol=self.config.tolerance, trace_ctx=trace_ctx)
+                tol=self.config.tolerance, trace_ctx=trace_ctx,
+                convergence_ring=convergence_ring,
+                margins_out=margins_out)
         else:
             result = minimize_lbfgs_glm_streaming(
                 self._sharded, coef0, self._l2,
                 max_iter=self.config.max_iterations,
-                tol=self.config.tolerance, trace_ctx=trace_ctx)
+                tol=self.config.tolerance, trace_ctx=trace_ctx,
+                convergence_ring=convergence_ring,
+                margins_out=margins_out)
         self._sharded.assert_trace_budget()
         from photon_ml_tpu.models.coefficients import Coefficients
 

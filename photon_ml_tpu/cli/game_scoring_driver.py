@@ -105,6 +105,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--serve admission bound (requests admitted and "
                         "unfinished); raised to --serve-concurrency if "
                         "lower, so the closed-loop replay never sheds")
+    p.add_argument("--distmon", action="store_true",
+                   help="distribution observability (--stream/--serve): "
+                        "per-model score sketch updated at scatter-back "
+                        "(one vectorized update per settled group, "
+                        "< 2%% overhead; a no-op without the flag), "
+                        "PSI/KS drift scores computed on scrape against "
+                        "the model's embedded referenceDistributions "
+                        "snapshot (trained with --distmon), exposed as "
+                        "serving.model.<label>.score_drift_psi/_ks "
+                        "gauges (SLO-able via --slo "
+                        "'drift=value:serving.model.default."
+                        "score_drift_psi<=0.25'), live /distz with "
+                        "--obs-port, and a distributions metrics.json "
+                        "block (docs/OBSERVABILITY.md §Distributions & "
+                        "drift)")
     p.add_argument("--trace-out", default=None, metavar="PATH",
                    help="write a Chrome trace-event JSON of the run's "
                         "pipeline spans here (load in Perfetto — "
@@ -272,12 +287,22 @@ def _run_scoring(args, out_dir, logger, obs) -> dict:
         raise SystemExit("--stream and --serve are mutually exclusive: "
                          "--stream is the bounded-memory bulk path, "
                          "--serve the concurrent-request replay harness")
+    if args.distmon and not (args.stream or args.serve):
+        raise SystemExit("--distmon attaches score sketches to the "
+                         "streaming engine's scatter-back; pass "
+                         "--stream or --serve")
+    # The model's embedded reference distributions (stamped by a
+    # --stream-train --distmon run) — what serving drift-scores
+    # against. None for models trained without --distmon.
+    reference = meta.get("referenceDistributions")
     if args.serve:
         summary = _run_serve(args, inputs, id_types, shard_maps, model,
-                             evaluators, scores_path, logger, obs)
+                             evaluators, scores_path, logger, obs,
+                             reference)
     elif args.stream:
         summary = _run_stream(args, inputs, id_types, shard_maps, model,
-                              evaluators, scores_path, logger)
+                              evaluators, scores_path, logger, obs,
+                              reference)
     else:
         with span("ingest"):
             data, _ = read_game_dataset(inputs, id_types=id_types,
@@ -306,8 +331,25 @@ def _run_scoring(args, out_dir, logger, obs) -> dict:
     return summary
 
 
+def _attach_score_monitor(args, engine, label, reference, obs):
+    """--distmon: hang a ScoreDistributionMonitor off the engine's
+    scatter-back settle, register /distz + the drift-gauge scrape hook
+    (drift computes on scrape — /metrics, /statusz, /distz, heartbeat —
+    and once more at finish before the SLO block). Returns the monitor
+    (None without the flag: the settle path stays a no-op branch)."""
+    if not args.distmon:
+        return None
+    from photon_ml_tpu.data.distmon import ScoreDistributionMonitor
+
+    mon = ScoreDistributionMonitor(label, reference=reference)
+    engine.score_monitor = mon
+    obs.add_dist_provider("serving", lambda: {label: mon.snapshot()})
+    obs.add_scrape_hook("score_drift", mon.publish_gauges)
+    return mon
+
+
 def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
-                scores_path, logger) -> dict:
+                scores_path, logger, obs, reference=None) -> dict:
     """Bounded-memory scoring through the three-stage decode -> H2D ->
     dispatch pipeline (serving engine `score_container_stream`: the
     block-stream feeder decodes + featureizes batch k+1 on its prefetch
@@ -329,6 +371,8 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
         # any other TypeError is an engine bug and propagates.
         raise SystemExit(
             f"--stream requires a device-scorable model: {e}") from e
+    score_mon = _attach_score_monitor(args, engine, "default",
+                                      reference, obs)
 
     try:
         # Stream construction scans the container block index (real I/O)
@@ -370,7 +414,7 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
 
     with span("evaluate"):
         metrics = acc.metrics(evaluators) if acc is not None else {}
-    return {
+    summary = {
         "num_rows": counters["rows"],
         "metrics": metrics,
         "scoring_path": "streaming-engine",
@@ -379,10 +423,14 @@ def _run_stream(args, inputs, id_types, shard_maps, model, evaluators,
         "feeder": scored.stream.stats(),
         "engine": engine.stats(),
     }
+    if score_mon is not None:
+        score_mon.publish_gauges()
+        summary["distributions"] = {"default": score_mon.snapshot()}
+    return summary
 
 
 def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
-               scores_path, logger, obs) -> dict:
+               scores_path, logger, obs, reference=None) -> dict:
     """Concurrent-request replay through the async serving front-end:
     the decoded input splits into ``--request-rows``-row requests,
     ``--serve-concurrency`` closed-loop requesters submit them on an
@@ -415,6 +463,8 @@ def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
     # stats, admission counters, and the shared executable cache's
     # tracing-guard counts (docs/OBSERVABILITY.md §Live endpoints).
     obs.add_status_provider("frontend", frontend.stats)
+    score_mon = _attach_score_monitor(args, frontend.engine("default"),
+                                      "default", reference, obs)
 
     with span("ingest"):
         requests = []
@@ -461,7 +511,7 @@ def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
                         scored_records())
     with span("evaluate"):
         metrics = acc.metrics(evaluators) if acc is not None else {}
-    return {
+    summary = {
         "num_rows": counters["rows"],
         "metrics": metrics,
         "scoring_path": "async-frontend",
@@ -471,6 +521,10 @@ def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
         "concurrency": args.serve_concurrency,
         "frontend": frontend.stats(),
     }
+    if score_mon is not None:
+        score_mon.publish_gauges()
+        summary["distributions"] = {"default": score_mon.snapshot()}
+    return summary
 
 
 def main() -> None:

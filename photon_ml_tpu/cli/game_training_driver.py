@@ -214,6 +214,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch-batches", type=int, default=2,
                    help="decode-ahead depth of the --stream-train feeder "
                         "(and spill re-upload look-ahead); 0 disables")
+    p.add_argument("--distmon", action="store_true",
+                   help="distribution observability (--stream-train "
+                        "only): streaming label/weight/offset/feature "
+                        "sketches piggybacked on the decode pass (zero "
+                        "extra feature passes; snapshots bitwise-"
+                        "identical across residency/feeder/prefetch "
+                        "configs), per-λ convergence rings, a "
+                        "data_quality metrics.json block, live /distz "
+                        "(with --obs-port), and a reference "
+                        "distribution snapshot (label + training-score "
+                        "quantiles) stamped into the model artifact "
+                        "for serving-side drift scoring "
+                        "(docs/OBSERVABILITY.md §Distributions & "
+                        "drift)")
     p.add_argument("--trace-out", default=None, metavar="PATH",
                    help="write a Chrome trace-event JSON of the run's "
                         "pipeline spans here (load in Perfetto — "
@@ -266,13 +280,21 @@ def run(argv=None) -> dict:
         # even on millisecond runs.
         with span("driver"):
             (sequence, results, best_configs, best_result, shard_maps,
-             num_rows, stream_info) = _run_training(
+             num_rows, stream_info, distmon_out) = _run_training(
                 args, logger, task, emitter, obs)
             _save_outputs(args, out_dir, logger, sequence, results,
-                          best_configs, best_result, shard_maps)
+                          best_configs, best_result, shard_maps,
+                          extra_metadata=(
+                              {"referenceDistributions":
+                               distmon_out["reference"]}
+                              if distmon_out is not None else None))
         summary = _write_summary(args, out_dir, logger, task, sequence,
                                  t0, results, best_configs, best_result,
-                                 num_rows, stream_info, obs)
+                                 num_rows, stream_info, obs,
+                                 data_quality=(
+                                     distmon_out["data_quality"]
+                                     if distmon_out is not None
+                                     else None))
         emitter.send_event(
             TrainingFinishEvent(args.job_name, summary["totalSeconds"]))
         return summary
@@ -382,6 +404,12 @@ def _run_training(args, logger, task, emitter, obs):
             "--spill-dtype bf16 compresses host spill buffers, but "
             "--spill-source redecode keeps none — the combination "
             "would silently train as f32; pick one")
+    if args.distmon and not args.stream_train:
+        raise ValueError(
+            "--distmon piggybacks distribution sketches on the "
+            "--stream-train decode pass; pass --stream-train (the "
+            "one-shot path has data/stats.py BasicStatisticalSummary "
+            "for one-shot statistics)")
 
     if args.stream_train:
         if re_data or len(sequence) != 1 \
@@ -400,18 +428,18 @@ def _run_training(args, logger, task, emitter, obs):
         with maybe_trace(args.profile_output_dir):
             if sequence[0] in fre_data:
                 (results, best_configs, best_result, shard_maps,
-                 num_rows, stream_info) = _stream_train_mf(
+                 num_rows, stream_info, distmon_out) = _stream_train_mf(
                     args, logger, task, fre_data, fre_opt, sequence,
                     train_inputs, evaluators, preloaded_maps, emitter,
                     obs)
             else:
                 (results, best_configs, best_result, shard_maps,
-                 num_rows, stream_info) = _stream_train(
+                 num_rows, stream_info, distmon_out) = _stream_train(
                     args, logger, task, fe_data, fe_opt, sequence,
                     train_inputs, evaluators, preloaded_maps, opt_grid,
                     emitter, obs)
         return (sequence, results, best_configs, best_result, shard_maps,
-                num_rows, stream_info)
+                num_rows, stream_info, distmon_out)
 
     logger.info("reading training data from %s (ingest workers: %s)",
                 train_inputs, args.ingest_workers)
@@ -487,14 +515,16 @@ def _run_training(args, logger, task, emitter, obs):
             checkpoint_interval=args.checkpoint_interval)
     best_configs, best_result = estimator.select_best(results)
     return (sequence, results, best_configs, best_result, shard_maps,
-            int(data.num_rows), None)
+            int(data.num_rows), None, None)
 
 
 def _save_outputs(args, out_dir, logger, sequence, results,
-                  best_configs, best_result, shard_maps) -> None:
+                  best_configs, best_result, shard_maps,
+                  extra_metadata=None) -> None:
     """Model + index-map save (the ``finalize`` phase) — shared by the
     one-shot and --stream-train paths (identical artifacts either
-    way)."""
+    way). ``extra_metadata`` merges extra model-metadata.json keys in
+    (the --distmon ``referenceDistributions`` snapshot)."""
     from photon_ml_tpu.models.tracking import summarize_trackers
 
     # Aggregate per-entity optimizer telemetry (convergence-reason counts,
@@ -520,6 +550,7 @@ def _save_outputs(args, out_dir, logger, sequence, results,
                 "updatingSequence": sequence,
                 "numIterations": args.num_iterations,
                 "optimizationTrackers": tracker_summary,
+                **(extra_metadata or {}),
             })
         # Persist the feature index maps next to the model so the scoring
         # driver can decode features identically (the reference ships
@@ -539,7 +570,7 @@ def _save_outputs(args, out_dir, logger, sequence, results,
 
 def _write_summary(args, out_dir, logger, task, sequence, t0, results,
                    best_configs, best_result, num_rows,
-                   stream_info, obs) -> dict:
+                   stream_info, obs, data_quality=None) -> dict:
     """metrics.json + trace export — runs AFTER the root ``driver`` span
     closed, so the telemetry block it snapshots includes the root's
     self time (the otherwise-unattributed driver glue)."""
@@ -562,6 +593,11 @@ def _write_summary(args, out_dir, logger, task, sequence, t0, results,
         # deprecated camelCase ``streamTrain`` alias rode one release
         # behind and is now removed (docs/OBSERVABILITY.md §Schema).
         summary["stream_train"] = stream_info
+    if data_quality is not None:
+        # --distmon: sketch summaries, per-λ convergence tails and the
+        # canonical state hash (the residency-independence witness) —
+        # docs/OBSERVABILITY.md §Distributions & drift.
+        summary["data_quality"] = data_quality
     obs.finish(summary)
     summary["telemetry"] = telemetry.attribution_summary(wall)
     if args.trace_out:
@@ -660,11 +696,32 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             shard_maps = {shard: build_index_map(
                 train_inputs, ingest_workers=args.ingest_workers)}
 
+    monitor = None
+    if args.distmon:
+        from photon_ml_tpu.data.distmon import (
+            MonitoredStream,
+            StreamingDistributionMonitor,
+        )
+
+        # Distribution sketches ride the decode pass: every batch the
+        # stream yields is observed on its way to the cache/assembler
+        # (on the prefetch thread when the feeder prefetches), so the
+        # statistics cost zero extra feature passes and their state is
+        # fixed by shard order — residency/feeder/prefetch-independent
+        # like the model bytes.
+        monitor = StreamingDistributionMonitor(feature_shards=[shard])
+        obs.add_dist_provider("training", monitor.snapshot)
+        obs.add_scrape_hook("distmon", monitor.publish_gauges)
+
     def make_stream():
-        return BlockGameStream(
+        s = BlockGameStream(
             train_inputs, id_types=[], feature_shard_maps=shard_maps,
             batch_rows=args.batch_rows, feeder=args.feeder,
             prefetch_depth=max(0, args.prefetch_batches))
+        return s if monitor is None else MonitoredStream(s, monitor)
+
+    def lam_label(cfg):
+        return f"{name}:l2={cfg.regularization_weight:g}"
 
     budget = args.hbm_budget  # parsed to bytes by argparse
     if args.checkpoint_dir and budget is not None:
@@ -747,6 +804,20 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                 shared = coord.sharded_objective
                 t0 = _time.perf_counter()
                 model, trackers, obj_hist = None, [], []
+                # --distmon hooks: a live per-λ convergence ring (loss/
+                # grad-norm/step per outer iteration, visible on /distz
+                # mid-solve) and the solver's final margins, from which
+                # training-score quantiles sketch without a scoring
+                # pass.
+                ring, margins_holder = None, None
+                if monitor is not None:
+                    from photon_ml_tpu.optimization.convergence import (
+                        ConvergenceRing,
+                    )
+
+                    ring = ConvergenceRing()
+                    monitor.add_ring(lam_label(cfg), ring)
+                    margins_holder = []
                 # One trace context per λ-grid point: the solve's
                 # identity across its outer iterations — slow solves
                 # land in the /tracez tail, and a divergence fault
@@ -756,9 +827,15 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                              reg_weight=cfg.regularization_weight,
                              optimizer=str(cfg.optimizer_type))
                 for _ in range(args.num_iterations):
-                    model, res = coord.solve(model, trace_ctx=ctx)
+                    model, res = coord.solve(
+                        model, trace_ctx=ctx, convergence_ring=ring,
+                        margins_out=margins_holder)
                     trackers.append(res)
                     obj_hist.append(float(res.value))
+                if monitor is not None and margins_holder:
+                    monitor.observe_scores(
+                        lam_label(cfg),
+                        shared.host_scores_from_margins(margins_holder))
                 ctx.annotate(
                     iterations=int(trackers[-1].iterations),
                     reason=trackers[-1].reason_enum().summary)
@@ -818,8 +895,43 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
     from photon_ml_tpu.estimators.game_estimator import select_best_result
 
     best_configs, best_result = select_best_result(results, evaluators)
+
+    distmon_out = None
+    if monitor is not None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        best_label = lam_label(best_configs[name])
+        if budget is None:
+            # Resident path: the fused in-core solvers ran — rings
+            # populate post-hoc from the tracker histories, and the
+            # best model's training scores come from ONE matvec over
+            # the already-resident assembled batch (device work only,
+            # no decode pass).
+            for configs, res in results:
+                # EVERY solve's history appends to the λ's ring (not
+                # just the last), matching the live streamed-solver
+                # rings under --num-iterations > 1.
+                for trk in res.trackers.get(name) or []:
+                    monitor.ring_from_history(
+                        lam_label(configs[name]),
+                        np.asarray(trk.value_history),
+                        np.asarray(trk.grad_norm_history))
+            batch = data.fixed_effect_batch(shard)
+            fe_model = best_result.best_model.models[name]
+            w = jnp.asarray(
+                np.asarray(fe_model.glm.coefficients.means),
+                np.asarray(batch.labels).dtype)
+            monitor.observe_scores(
+                best_label, np.asarray(batch.features.matvec(w)))
+        monitor.publish_gauges()
+        distmon_out = {
+            "data_quality": monitor.data_quality_block(),
+            "reference": monitor.reference(score_label=best_label),
+        }
+
     return (results, best_configs, best_result, shard_maps, num_rows,
-            stream_info)
+            stream_info, distmon_out)
 
 
 def _stream_train_mf(args, logger, task, fre_data, fre_opt, sequence,
@@ -876,6 +988,22 @@ def _stream_train_mf(args, logger, task, fre_data, fre_opt, sequence,
                 train_inputs, ingest_workers=args.ingest_workers)}
 
     stream_holder = {}
+    monitor = None
+    if args.distmon:
+        from photon_ml_tpu.data.distmon import (
+            MonitoredStream,
+            StreamingDistributionMonitor,
+        )
+
+        # MF re-decodes observations once per feature pass; the monitor
+        # observes exactly ONE full pass (max_passes=1 on the first
+        # stream) so every row counts once — the later passes replay
+        # identical bytes (the PR 12 determinism contract), so one pass
+        # IS the distribution.
+        monitor = StreamingDistributionMonitor(
+            feature_shards=[shard], id_types=[re_type])
+        obs.add_dist_provider("training", monitor.snapshot)
+        obs.add_scrape_hook("distmon", monitor.publish_gauges)
 
     def make_stream():
         s = BlockGameStream(
@@ -884,6 +1012,9 @@ def _stream_train_mf(args, logger, task, fre_data, fre_opt, sequence,
             batch_rows=args.batch_rows, feeder=args.feeder,
             prefetch_depth=max(0, args.prefetch_batches))
         stream_holder["last"] = s
+        if monitor is not None and not stream_holder.get("observed"):
+            stream_holder["observed"] = True
+            return MonitoredStream(s, monitor, max_passes=1)
         return s
 
     budget = args.hbm_budget
@@ -1028,8 +1159,20 @@ def _stream_train_mf(args, logger, task, fre_data, fre_opt, sequence,
     from photon_ml_tpu.estimators.game_estimator import select_best_result
 
     best_configs, best_result = select_best_result(results, evaluators)
+
+    distmon_out = None
+    if monitor is not None:
+        monitor.publish_gauges()
+        # MF reference carries label quantiles only (no cheap training-
+        # score surface exists — scores need a full gather+dot pass);
+        # serving drift degrades gracefully without a "score" block.
+        distmon_out = {
+            "data_quality": monitor.data_quality_block(),
+            "reference": monitor.reference(),
+        }
+
     return (results, best_configs, best_result, shard_maps, num_rows,
-            stream_info)
+            stream_info, distmon_out)
 
 
 def main() -> None:

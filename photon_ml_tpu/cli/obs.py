@@ -52,7 +52,9 @@ def add_observability_args(p) -> None:
                         "/statusz (registry + stage attribution + "
                         "per-model serving stats + profiler table + "
                         "SLO), /tracez (tail-sampled request/solve "
-                        "timelines), /debugz/dump (flight recorder). "
+                        "timelines), /distz (live label/feature/score "
+                        "distributions + drift, with --distmon), "
+                        "/debugz/dump (flight recorder). "
                         "0 binds an ephemeral port, written to "
                         "<output-dir>/obs_port and reported in "
                         "metrics.json (docs/OBSERVABILITY.md)")
@@ -109,6 +111,12 @@ class DriverObservability:
                 dump_path=self.flight_path)
         self._restore_sigterm: Optional[Callable[[], None]] = None
         self._fault_dumped = False
+        # Scrape hooks registered by the driver (--distmon gauge
+        # refreshers): kept locally so finish() can refresh computed
+        # gauges before the final SLO evaluation even when no server is
+        # running, and registered with the server (when present) so
+        # live scrapes and heartbeat ticks refresh them too.
+        self._scrape_hooks: Dict[str, Callable[[], None]] = {}
 
     def start(self) -> "DriverObservability":
         if self.recorder is not None:
@@ -130,6 +138,23 @@ class DriverObservability:
         server — the provider contract is read-only either way)."""
         if self.server is not None:
             self.server.add_status_provider(name, fn)
+
+    def add_dist_provider(self, name: str,
+                          fn: Callable[[], dict]) -> None:
+        """Expose a distribution snapshot under /distz (data/distmon.py;
+        no-op without a server — metrics.json carries the final
+        snapshot either way)."""
+        if self.server is not None:
+            self.server.add_distribution_provider(name, fn)
+
+    def add_scrape_hook(self, name: str,
+                        fn: Callable[[], None]) -> None:
+        """Register a computed-gauge refresher: runs before every live
+        scrape / heartbeat tick (when a server is up) and once in
+        :meth:`finish` before the final SLO evaluation."""
+        self._scrape_hooks[name] = fn
+        if self.server is not None:
+            self.server.add_scrape_hook(name, fn)
 
     def dump_fault(self, exc: BaseException, logger=None) -> None:
         """Unhandled-fault hook: leave flight.json evidence. SystemExit
@@ -160,6 +185,11 @@ class DriverObservability:
         """Attach the ``slo`` and ``observability`` metrics.json blocks
         (call before the summary is written, while the server counters
         are final-ish)."""
+        for fn in self._scrape_hooks.values():
+            try:
+                fn()  # final refresh: the slo block judges fresh gauges
+            except Exception:  # noqa: BLE001 — summary is best-effort
+                pass
         if self.slo_tracker is not None:
             summary["slo"] = self.slo_tracker.evaluate()
         if self.server is not None or self.recorder is not None:

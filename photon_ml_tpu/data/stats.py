@@ -9,6 +9,19 @@ import numpy as np
 import scipy.sparse as sp
 
 
+class EmptyDatasetError(ValueError):
+    """``BasicStatisticalSummary.compute`` was handed a matrix with no
+    rows. Raised instead of silently emitting all-NaN mean/variance
+    arrays (``s1 / 0``), which poisoned every downstream consumer with
+    NaNs that only surfaced much later."""
+
+    def __init__(self, shape):
+        super().__init__(
+            f"cannot summarize an empty matrix (shape {tuple(shape)}): "
+            "statistics over 0 rows are undefined")
+        self.shape = tuple(shape)
+
+
 @dataclasses.dataclass(frozen=True)
 class BasicStatisticalSummary:
     mean: np.ndarray
@@ -24,8 +37,13 @@ class BasicStatisticalSummary:
     @classmethod
     def compute(cls, mat) -> "BasicStatisticalSummary":
         """From a scipy sparse or dense [n, d] matrix. Sparse zeros
-        participate in mean/var/min/max exactly as MLlib colStats does."""
+        participate in mean/var/min/max exactly as MLlib colStats does.
+        Raises :class:`EmptyDatasetError` on an n=0 matrix (the
+        division by ``n`` below is undefined; NaN arrays would
+        propagate silently)."""
         n = mat.shape[0]
+        if n == 0:
+            raise EmptyDatasetError(mat.shape)
         if sp.issparse(mat):
             m = mat.tocsc()
             s1 = np.asarray(m.sum(axis=0)).ravel()

@@ -480,6 +480,24 @@ class ShardedGLMObjective:
         _, f, g = self.margins_value_grad(coef, jnp.asarray(l2))
         return f, g
 
+    def host_scores_from_margins(self, z_list: Sequence) -> np.ndarray:
+        """Host training-score vector from a solver's final per-shard
+        margins (the ``margins_out`` hook of the streaming solvers):
+        margins include per-row offsets (``GLMObjective.margins``), so
+        offsets are subtracted back out and each shard's padding rows
+        sliced off — giving model scores in the fixed shard order (==
+        original row order), for ``--distmon`` training-score sketches
+        WITHOUT a scoring feature pass. Row-space only: never touches
+        feature residency or the spill tiers."""
+        if len(z_list) != len(self.cache.entries):
+            raise ValueError(
+                f"margin list has {len(z_list)} shards, cache has "
+                f"{len(self.cache.entries)} — not this objective's "
+                "margins?")
+        parts = [np.asarray(z - e.offsets)[:e.n_rows]
+                 for e, z in zip(self.cache.entries, z_list)]
+        return np.concatenate(parts) if parts else np.zeros(0)
+
     def margin_direction_list(self, direction: Array) -> List[Array]:
         """Per-shard directional margins (one feature pass)."""
         out: List[Array] = []

@@ -16,11 +16,56 @@ from __future__ import annotations
 import dataclasses
 import enum
 import math
+import threading
+from collections import deque
 from typing import Optional
 
 import jax
 
 Array = jax.Array
+
+
+class ConvergenceRing:
+    """Bounded per-outer-iteration solver history ring (loss, gradient
+    norm, accepted step size) — the live-observable complement of
+    :class:`OptimizerResult`'s padded history arrays.
+
+    The host-driven streaming solvers (optimization/glm_lbfgs.py /
+    tron.py ``convergence_ring=``) append one entry per outer iteration
+    as it happens, so a multi-hour ``--stream-train --distmon`` run's
+    /distz shows each λ-grid point's convergence tail LIVE; the fused
+    ``lax.while_loop`` solvers cannot (no host callbacks mid-solve) and
+    get their rings populated post-hoc from the result histories
+    (data/distmon.py ``ring_from_history`` — ``step`` is None there).
+    Bounded: only the newest ``capacity`` entries are retained
+    (``recorded`` counts all appends). Lock-guarded: the solver thread
+    appends while scrape threads snapshot."""
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.recorded = 0
+        self._entries: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    def append(self, iteration: int, value, grad_norm,
+               step=None) -> None:
+        entry = {
+            "iteration": int(iteration),
+            "value": float(value),
+            "grad_norm": float(grad_norm),
+            "step": None if step is None else float(step),
+        }
+        with self._lock:
+            self.recorded += 1
+            self._entries.append(entry)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "recorded": self.recorded,
+                    "tail": [dict(e) for e in self._entries]}
 
 
 def check_solver_finite(solver: str, iteration: int, value, grad_norm,

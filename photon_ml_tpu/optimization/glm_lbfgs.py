@@ -284,6 +284,8 @@ def minimize_lbfgs_glm_streaming(
     max_line_search: int = 30,
     track_coefficients: bool = False,
     trace_ctx=None,
+    convergence_ring=None,
+    margins_out=None,
 ) -> OptimizerResult:
     """Out-of-core L-BFGS: the outer iteration runs on the host, streaming
     each feature pass through a :class:`ShardedGLMObjective`
@@ -329,6 +331,17 @@ def minimize_lbfgs_glm_streaming(
     minted per λ-grid point by the streaming driver) gets one
     ``solver_step`` event per outer iteration and, on divergence, a
     ``diverged`` finish whose trace_id tags the fault and flight dump.
+
+    Distribution-observability hooks (``--distmon``, data/distmon.py):
+    ``convergence_ring`` (a
+    :class:`~photon_ml_tpu.optimization.convergence.ConvergenceRing`)
+    gets one ``(iteration, loss, grad_norm, accepted step)`` entry per
+    outer iteration — the host already holds every one of those scalars
+    for the convergence compares, so the ring adds no sync; and
+    ``margins_out`` (a caller-owned list) is replaced with the FINAL
+    per-shard margin list, letting the driver sketch training-score
+    quantiles from state the solve computed anyway — zero extra feature
+    passes (``ShardedGLMObjective.host_scores_from_margins``).
     """
     import numpy as np
 
@@ -353,6 +366,8 @@ def minimize_lbfgs_glm_streaming(
     f_h = host(f)
     gnorm = host(jnp.linalg.norm(g))
     check_solver_finite("streaming-lbfgs", 0, f_h, gnorm, trace_ctx)
+    if convergence_ring is not None:
+        convergence_ring.append(0, f_h, gnorm, None)
     gnorm0 = gnorm
     f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
     hist = _empty_history(d, history_size, dtype)
@@ -416,6 +431,9 @@ def minimize_lbfgs_glm_streaming(
                     value_hist[it], gnorm_hist[it] = f_h, gnorm
                     if coef_hist is not None:
                         coef_hist[it] = np.asarray(x)
+                if convergence_ring is not None:
+                    # Failed line search: the iterate did not move.
+                    convergence_ring.append(it, f_h, gnorm, 0.0)
                 break
 
             x_new = _stream_axpy(x, t_acc, direction)
@@ -438,6 +456,8 @@ def minimize_lbfgs_glm_streaming(
             value_hist[it], gnorm_hist[it] = f_h, gnorm
             if coef_hist is not None:
                 coef_hist[it] = np.asarray(x)
+            if convergence_ring is not None:
+                convergence_ring.append(it, f_h, gnorm, host(t_acc))
 
             if gnorm_new <= tol_s * gnorm0:
                 reason = ConvergenceReason.GRADIENT_CONVERGED
@@ -446,6 +466,11 @@ def minimize_lbfgs_glm_streaming(
             elif it >= max_iter:
                 reason = ConvergenceReason.MAX_ITERATIONS
 
+    if margins_out is not None:
+        # Final per-shard margins (aligned with cache.entries) — the
+        # driver sketches training scores from these instead of paying
+        # a scoring pass.
+        margins_out[:] = z_list
     return OptimizerResult(
         x=x, value=f, grad_norm=jnp.asarray(gnorm, dtype),
         iterations=jnp.asarray(it, jnp.int32),

@@ -361,6 +361,8 @@ def minimize_tron_streaming(
     max_improvement_failures: int = 5,
     track_coefficients: bool = False,
     trace_ctx=None,
+    convergence_ring=None,
+    margins_out=None,
 ) -> OptimizerResult:
     """Out-of-core TRON: the outer trust-region loop runs on the host;
     each value/gradient evaluation and each inner-CG Hessian-vector
@@ -391,7 +393,13 @@ def minimize_tron_streaming(
     event per accepted or rejected outer step. An unaccepted trial with
     non-finite value is NOT a divergence — the trust region shrinks and
     retries, exactly like the fused impl — so only the accepted state
-    is checked."""
+    is checked.
+
+    ``convergence_ring`` / ``margins_out`` — same distribution-
+    observability hooks as ``minimize_lbfgs_glm_streaming``: one ring
+    entry per ACCEPTED outer iteration (step = ||s||, the trust-region
+    step actually taken; all scalars already host-side), and the final
+    per-shard margin list for zero-pass training-score sketching."""
     import numpy as np
 
     sobj = sharded_objective
@@ -408,6 +416,8 @@ def minimize_tron_streaming(
     f_h = host(f)
     gnorm = host(jnp.linalg.norm(g))
     check_solver_finite("streaming-tron", 0, f_h, gnorm, trace_ctx)
+    if convergence_ring is not None:
+        convergence_ring.append(0, f_h, gnorm, None)
     gnorm0 = gnorm
     f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
     delta = jnp.asarray(gnorm0, dtype)
@@ -473,6 +483,9 @@ def minimize_tron_streaming(
                 value_hist[it], gnorm_hist[it] = f_h, gnorm
                 if coef_hist is not None:
                     coef_hist[it] = np.asarray(x)
+                if convergence_ring is not None:
+                    convergence_ring.append(
+                        it, f_h, gnorm, host(jnp.linalg.norm(s)))
                 if gnorm <= tol_s * gnorm0:
                     reason = ConvergenceReason.GRADIENT_CONVERGED
                 elif f_delta <= tol_s * f0_scale:
@@ -484,6 +497,8 @@ def minimize_tron_streaming(
                 if fails > max_improvement_failures:
                     reason = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
 
+    if margins_out is not None:
+        margins_out[:] = z_list
     return OptimizerResult(
         x=x, value=f, grad_norm=jnp.asarray(gnorm, dtype),
         iterations=jnp.asarray(it, jnp.int32),

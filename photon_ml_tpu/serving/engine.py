@@ -194,6 +194,14 @@ class StreamingGameScorer:
         self._shards: Dict[str, int] = {}  # shard id -> n_features
         self._stats = {"dispatches": 0, "requests": 0, "rows_scored": 0,
                        "rows_padded": 0, "nnz_scored": 0, "nnz_padded": 0}
+        # Optional per-model score-distribution monitor
+        # (data/distmon.py ScoreDistributionMonitor, attached by the
+        # --serve --distmon driver). Fed at scatter-back with one
+        # vectorized update per settled GROUP — the same deferred-
+        # settle recipe as PR 11's tail sampling. None (the default) is
+        # a no-op BY CONSTRUCTION: the settle path is one attribute
+        # load + branch.
+        self.score_monitor = None
         # ``cache`` lets several engines share one executable population
         # (multi-model tenancy — the front-end's registry passes its
         # cache to every resident engine; keys carry the model structure
@@ -590,6 +598,8 @@ class StreamingGameScorer:
             for idx, chunk in zip(idxs, np.split(host[:n_real], splits)):
                 results[idx] = chunk
             self._observe_latency(lat, n=len(idxs))
+            if self.score_monitor is not None:
+                self.score_monitor.observe(host[:n_real])
             # Dispatch-to-settle wall per rows bucket, at the existing
             # block_until_ready boundary (the window already synced) —
             # the per-bucket device-time view on /statusz.
@@ -627,6 +637,8 @@ class StreamingGameScorer:
         def settle(done):
             out, n_real, t_start, rows_b, t_disp = done
             pending.append(np.asarray(out)[:n_real])
+            if self.score_monitor is not None:
+                self.score_monitor.observe(pending[-1])
             now = time.perf_counter()
             self.cache.profiler.record_dispatch(rows_b, now - t_disp,
                                                 n_real)
@@ -752,4 +764,6 @@ class StreamingGameScorer:
         h = self._h_latency if self._h_latency is not None \
             else _H_REQUEST_LATENCY
         s["request_latency_seconds"] = h.snapshot()
+        if self.score_monitor is not None:
+            s["score_distribution"] = self.score_monitor.snapshot()
         return s

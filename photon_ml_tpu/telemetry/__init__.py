@@ -55,7 +55,14 @@ from photon_ml_tpu.telemetry.slo import (
     LatencyObjective,
     RatioObjective,
     SLOTracker,
+    ValueObjective,
     parse_slo,
+)
+from photon_ml_tpu.telemetry.sketches import (
+    MomentsSketch,
+    QuantileSketch,
+    TopKSketch,
+    sketch_from_state,
 )
 from photon_ml_tpu.telemetry import tracectx as _tracectx_mod
 from photon_ml_tpu.telemetry.tracectx import (
@@ -125,13 +132,17 @@ __all__ = [
     "Histogram",
     "LatencyObjective",
     "MetricsRegistry",
+    "MomentsSketch",
     "NOOP_CONTEXT",
     "ObservabilityServer",
+    "QuantileSketch",
     "RatioObjective",
     "SLOTracker",
+    "TopKSketch",
     "TraceContext",
     "TraceTail",
     "Tracer",
+    "ValueObjective",
     "attribution_summary",
     "counter",
     "disable",
@@ -147,6 +158,7 @@ __all__ = [
     "registry",
     "render_prometheus",
     "reset",
+    "sketch_from_state",
     "snapshot",
     "span",
     "stage_attribution",

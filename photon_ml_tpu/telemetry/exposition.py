@@ -234,6 +234,7 @@ class ObservabilityServer:
     """Background-thread HTTP server exposing the live telemetry plane.
 
     Routes: ``/metrics`` (Prometheus text), ``/healthz``, ``/statusz``,
+    ``/tracez``, ``/distz`` (distribution providers — data/distmon.py),
     ``/debugz/dump``. ``port=0`` binds an ephemeral port; read ``.port``
     after :meth:`start`. Optional collaborators:
 
@@ -278,6 +279,19 @@ class ObservabilityServer:
         self._provider_errors: Dict[str, int] = {}
         self._providers: Dict[str, Callable[[], dict]] = dict(
             status_providers or {})
+        # /distz distribution providers (data/distmon.py) + pre-scrape
+        # hooks. Hooks run at the top of every scrape route AND each
+        # heartbeat tick: they refresh gauges that are COMPUTED rather
+        # than event-driven (drift scores, distribution headline
+        # gauges), so a /metrics scrape — and the heartbeat's SLO
+        # evaluation — always reads current values with no polling
+        # thread of their own. Hook errors are isolated and counted
+        # like provider errors.
+        self._dist_providers: Dict[str, Callable[[], dict]] = {}
+        self._scrape_hooks: Dict[str, Callable[[], None]] = {}
+        self._hook_errors: Dict[str, int] = {}
+        self._m_hook_errors = _reg.registry().counter(
+            "obs.scrape_hook_errors")
         self._httpd: Optional[_ObsHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._hb_thread: Optional[threading.Thread] = None
@@ -289,13 +303,24 @@ class ObservabilityServer:
             "/statusz": self._statusz,
             "/debugz/dump": self._debugz_dump,
             "/tracez": self._tracez,
+            "/distz": self._distz,
         }
 
     # -- routes ------------------------------------------------------------
 
+    def _run_scrape_hooks(self) -> None:
+        for name, fn in sorted(self._scrape_hooks.items()):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a hook must not fail a scrape
+                self._hook_errors[name] = \
+                    self._hook_errors.get(name, 0) + 1
+                self._m_hook_errors.inc()
+
     def _metrics(self, accept: str = ""):
         self.scrapes += 1
         self._m_scrapes.inc()
+        self._run_scrape_hooks()
         # Content negotiation: exemplar syntax is only legal under
         # OpenMetrics, so a plain scraper gets clean text 0.0.4 (no
         # exemplars — a mid-line '#' would fail its whole scrape) and
@@ -319,6 +344,7 @@ class ObservabilityServer:
         }) + "\n", "application/json")
 
     def _statusz(self, accept: str = ""):
+        self._run_scrape_hooks()
         status = {}
         failing = []
         for name, fn in sorted(self._providers.items()):
@@ -340,6 +366,7 @@ class ObservabilityServer:
             "status": status,
             "failing_providers": failing,
             "provider_errors": dict(self._provider_errors),
+            "scrape_hook_errors": dict(self._hook_errors),
             "slo": (self.slo_tracker.evaluate()
                     if self.slo_tracker is not None else None),
             "flight_recorder": (self.recorder.stats()
@@ -357,6 +384,26 @@ class ObservabilityServer:
                            default=_json_default) + "\n",
                 "application/json")
 
+    def _distz(self, accept: str = ""):
+        """Live distribution observability (data/distmon.py): training
+        label/weight/offset/feature sketches + convergence tails, and
+        per-model serving score sketches + drift — whatever providers
+        the driver registered. Provider errors report inline, mirroring
+        /statusz."""
+        self._run_scrape_hooks()
+        body = {}
+        for name, fn in sorted(self._dist_providers.items()):
+            try:
+                body[name] = fn()
+            except Exception as e:  # noqa: BLE001 — report, don't 500
+                body[name] = {"provider": name,
+                              "error": f"{type(e).__name__}: {e}"}
+                self._provider_errors[name] = \
+                    self._provider_errors.get(name, 0) + 1
+                self._m_provider_errors.inc()
+        return (json.dumps(body, indent=2, default=_json_default) + "\n",
+                "application/json")
+
     def _debugz_dump(self, accept: str = ""):
         if self.recorder is None:
             return (json.dumps({"error": "no flight recorder installed "
@@ -371,6 +418,17 @@ class ObservabilityServer:
     def add_status_provider(self, name: str,
                             fn: Callable[[], dict]) -> None:
         self._providers[name] = fn
+
+    def add_distribution_provider(self, name: str,
+                                  fn: Callable[[], dict]) -> None:
+        """Expose a distribution snapshot provider under /distz."""
+        self._dist_providers[name] = fn
+
+    def add_scrape_hook(self, name: str,
+                        fn: Callable[[], None]) -> None:
+        """Register a pre-scrape refresh hook (run before /metrics,
+        /statusz and /distz render, and on each heartbeat tick)."""
+        self._scrape_hooks[name] = fn
 
     @property
     def port(self) -> Optional[int]:
@@ -407,6 +465,9 @@ class ObservabilityServer:
             beat.set(time.time())
             if self.recorder is not None:
                 self.recorder.tick()
+            # Hooks BEFORE SLO evaluation: a value objective over a
+            # computed gauge (drift) must judge a fresh value.
+            self._run_scrape_hooks()
             if self.slo_tracker is not None:
                 self.slo_tracker.evaluate()
 

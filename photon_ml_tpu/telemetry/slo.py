@@ -32,6 +32,13 @@ Declaration syntax (CLI ``--slo``, docs/OBSERVABILITY.md):
   ``us``/``ms``/``s``, bare numbers are seconds)
 - ``[name=]ratio:serving.frontend.rejected/serving.frontend.admitted+``
   ``serving.frontend.rejected<=0.02`` (denominator counters sum)
+- ``[name=]value:serving.model.default.score_drift_psi<=0.25`` (a
+  registry GAUGE must stay <= the bound; ``burn_rate = value / max``).
+  This is what makes COMPUTED gauges — the ``--distmon`` drift scores,
+  refreshed by scrape hooks before every evaluation — SLO-able with no
+  new alerting code: the same burn/violation counters, /statusz block
+  and metrics.json ``slo`` entry as the latency/ratio kinds. A gauge
+  that was never set burns nothing (no traffic to judge).
 
 An explicit ``name=`` prefix names the objective's metric family;
 otherwise a snake_case name is derived from the spec.
@@ -85,7 +92,21 @@ class RatioObjective:
                 f"{' + '.join(self.denominators)} <= {self.max_ratio:g}")
 
 
-Objective = Union[LatencyObjective, RatioObjective]
+@dataclasses.dataclass(frozen=True)
+class ValueObjective:
+    """Registry gauge ``gauge`` must stay <= ``max_value`` (e.g. a
+    drift score <= 0.25); ``burn_rate = value / max_value``. Judged
+    only once the gauge has been set at least once."""
+
+    name: str
+    gauge: str
+    max_value: float
+
+    def describe(self) -> str:
+        return f"{self.gauge} <= {self.max_value:g}"
+
+
+Objective = Union[LatencyObjective, RatioObjective, ValueObjective]
 
 
 def _parse_duration_s(text: str) -> float:
@@ -138,7 +159,14 @@ def parse_slo(spec: str) -> Objective:
             numerator=num.strip(),
             denominators=tuple(d.strip() for d in dens.split("+")),
             max_ratio=float(rhs))
-    raise ValueError(f"unknown SLO kind {kind!r} (p<q> or ratio)")
+    if kind == "value":
+        if not lhs:
+            raise ValueError(
+                f"bad value SLO {spec!r}: expected value:<gauge><=X")
+        return ValueObjective(
+            name=name or f"value_{lhs.replace('.', '_')}",
+            gauge=lhs, max_value=float(rhs))
+    raise ValueError(f"unknown SLO kind {kind!r} (p<q>, ratio or value)")
 
 
 def _frac_over_threshold(hist: _reg.Histogram,
@@ -205,6 +233,13 @@ class SLOTracker:
                 return None, None
             return (hist.quantile(o.quantile),
                     frac_over / (1.0 - o.quantile))
+        if isinstance(o, ValueObjective):
+            g = reg.gauge(o.gauge)
+            if g.calls == 0:
+                return None, None  # never set: nothing to judge
+            v = g.value
+            return v, (v / o.max_value if o.max_value > 0
+                       else float("inf"))
         den = sum(reg.counter(d).value for d in o.denominators)
         if den <= 0:
             return None, None
@@ -231,6 +266,7 @@ class SLOTracker:
             burn_gauge.set(0.0 if burn is None else burn)
             entry = {
                 "kind": ("latency" if isinstance(o, LatencyObjective)
+                         else "value" if isinstance(o, ValueObjective)
                          else "ratio"),
                 "objective": o.describe(),
                 "current": current,
@@ -242,6 +278,8 @@ class SLOTracker:
             if isinstance(o, LatencyObjective):
                 entry["quantile"] = o.quantile
                 entry["threshold_s"] = o.threshold_s
+            elif isinstance(o, ValueObjective):
+                entry["max_value"] = o.max_value
             else:
                 entry["max_ratio"] = o.max_ratio
             out[o.name] = entry

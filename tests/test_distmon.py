@@ -1,0 +1,474 @@
+"""Distribution observability (data/distmon.py + the /distz plane +
+the --distmon driver wiring): monitor semantics, the transparent stream
+wrapper, serving score sketches at scatter-back, drift gauges + the SLO
+value objective, the stats.py empty-matrix fix, and the CLI acceptance
+contracts — bitwise-identical training snapshots across residency/
+feeder/prefetch configs and PSI drift that fires on shifted traffic
+only."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.data.distmon import (
+    MonitoredStream,
+    ScoreDistributionMonitor,
+    StreamingDistributionMonitor,
+)
+from photon_ml_tpu.data.game_data import GameDataset
+from photon_ml_tpu.data.stats import BasicStatisticalSummary, EmptyDatasetError
+from photon_ml_tpu.models import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    LogisticRegressionModel,
+)
+from photon_ml_tpu.serving import BucketLadder, StreamingGameScorer
+from photon_ml_tpu.telemetry import ObservabilityServer, SLOTracker, parse_slo
+from photon_ml_tpu.telemetry.sketches import QuantileSketch
+from photon_ml_tpu.telemetry.slo import ValueObjective
+from photon_ml_tpu.types import TaskType
+
+from tests.test_cli_drivers import _STREAM_BASE, _coeff_records  # noqa: F401
+from photon_ml_tpu.cli import game_scoring_driver, game_training_driver
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import write_container
+
+
+def _batch(rng, n=50, d=6, users=None):
+    mat = sp.random(n, d, density=0.4, random_state=7, format="csr")
+    ids = {} if users is None else {"userId": users}
+    return GameDataset.build(
+        responses=rng.normal(0, 1, n),
+        feature_shards={"global": mat},
+        ids=ids,
+        weights=np.full(n, 2.0),
+        offsets=np.zeros(n))
+
+
+# -- StreamingDistributionMonitor ------------------------------------------
+
+def test_monitor_observe_and_snapshot(rng):
+    mon = StreamingDistributionMonitor(feature_shards=["global"],
+                                       id_types=["userId"])
+    users = np.array(["alice"] * 30 + ["bob"] * 15 + ["carol"] * 5)
+    ds = _batch(rng, n=50, users=users)
+    mon.observe_batch(ds)
+    mon.observe_batch(_batch(rng, n=20, users=users[:20]))
+    snap = mon.snapshot()
+    assert snap["rows"] == 70 and snap["batches"] == 2
+    lab = snap["columns"]["label"]
+    assert lab["moments"]["count"] == 70
+    assert lab["quantiles"]["p50"] is not None
+    assert snap["columns"]["weight"]["moments"]["mean"] == 2.0
+    fs = snap["feature_shards"]["global"]
+    assert fs["moments"]["count"] > 0  # the CSR nonzeros
+    top = dict((k, c) for k, c in snap["entities"]["userId"]["top"])
+    assert top.get("alice", 0) >= 30  # exact: never decremented here
+    # zero-row batches are no-ops
+    mon.observe_batch(_batch(rng, n=4).subset(np.zeros(0, np.int64)))
+    assert mon.rows == 70
+    # serialize excludes scores/rings; adding them must not move the hash
+    h0 = mon.state_sha256()
+    mon.observe_scores("l2=1", rng.normal(0, 1, 70))
+    mon.ring_from_history("l2=1", [3.0, 2.0, np.nan], [1.0, 0.5, np.nan])
+    assert mon.state_sha256() == h0
+    dq = mon.data_quality_block()
+    assert dq["state_sha256"] == h0
+    assert dq["convergence"]["l2=1"]["tail"][-1]["iteration"] == 1
+    assert dq["training_scores"]["l2=1"]["quantiles"]["count"] == 70
+    ref = mon.reference(score_label="l2=1")
+    assert ref["score_label"] == "l2=1"
+    assert ref["label"]["count"] == 70 and "score" in ref
+    # unknown score label: reference degrades to label-only
+    assert "score" not in mon.reference(score_label="nope")
+
+
+def test_monitor_determinism_same_batches(rng):
+    batches = [_batch(np.random.default_rng(i), n=33) for i in range(4)]
+
+    def run():
+        m = StreamingDistributionMonitor(feature_shards=["global"])
+        for b in batches:
+            m.observe_batch(b)
+        return m.state_sha256()
+
+    assert run() == run()
+
+
+def test_monitored_stream_delegates_and_bounds_passes(rng):
+    batches = [_batch(rng, n=10) for _ in range(3)]
+
+    class FakeStream:
+        decode_path = "python"
+
+        def __iter__(self):
+            return iter(batches)
+
+        def stats(self):
+            return {"rows": 30}
+
+    mon = StreamingDistributionMonitor(feature_shards=["global"])
+    ms = MonitoredStream(FakeStream(), mon)
+    assert ms.decode_path == "python"  # attribute delegation
+    assert ms.stats() == {"rows": 30}
+    out = list(ms)
+    assert len(out) == 3 and out[0] is batches[0]  # batches untouched
+    assert mon.rows == 30
+    list(ms)  # default: every pass observed
+    assert mon.rows == 60
+    mon2 = StreamingDistributionMonitor(feature_shards=["global"])
+    once = MonitoredStream(FakeStream(), mon2, max_passes=1)
+    list(once)
+    list(once)  # second pass yields but does not observe
+    assert mon2.rows == 30
+
+
+# -- stats.py satellite -----------------------------------------------------
+
+def test_basic_statistics_empty_matrix_raises_typed():
+    for mat in (sp.csr_matrix((0, 5)), np.zeros((0, 5))):
+        with pytest.raises(EmptyDatasetError) as ei:
+            BasicStatisticalSummary.compute(mat)
+        assert ei.value.shape == (0, 5)
+        assert isinstance(ei.value, ValueError)  # old callers still catch
+    # the n>0 path is unchanged (no NaNs, exact mean)
+    s = BasicStatisticalSummary.compute(np.array([[1.0, 0.0], [3.0, 2.0]]))
+    np.testing.assert_allclose(s.mean, [2.0, 1.0])
+    assert not np.isnan(s.variance).any()
+
+
+# -- serving score sketch + drift ------------------------------------------
+
+def _fe_model_engine(rng, d=6):
+    w = rng.normal(0, 1, d)
+    fe = FixedEffectModel(
+        LogisticRegressionModel(Coefficients(jnp.asarray(w))), "global")
+    gm = GameModel({"fixed": fe}, TaskType.LOGISTIC_REGRESSION)
+    eng = StreamingGameScorer(
+        gm, dtype=jnp.float32,
+        ladder=BucketLadder(min_rows=8, max_rows=64))
+    return eng, w
+
+
+def test_engine_score_monitor_fed_at_settle(rng):
+    eng, _ = _fe_model_engine(rng)
+    reqs = [_batch(rng, n=n) for n in (5, 7, 11)]
+    assert eng.score_monitor is None  # disabled path: no-op branch
+    eng.score_many(reqs)
+    mon = ScoreDistributionMonitor("default")
+    eng.score_monitor = mon
+    results = eng.score_many(reqs)
+    assert mon.snapshot()["scores"]["moments"]["count"] == 23
+    # the sketch saw exactly the scores the caller got
+    sk = QuantileSketch(mon._sketch.quantiles.relative_accuracy)
+    sk.update(np.concatenate(results))
+    assert sk.serialize() == mon._sketch.quantiles.serialize()
+    # score_stream settles feed it too
+    for _ in eng.score_stream([reqs[0]]):
+        pass
+    assert mon.snapshot()["scores"]["moments"]["count"] == 28
+    assert "score_distribution" in eng.stats()
+
+
+def test_score_monitor_drift_and_gauges(rng):
+    ref_scores = rng.normal(0, 1, 5000)
+    ref_sk = QuantileSketch(0.02)
+    ref_sk.update(ref_scores)
+    reference = {"score": ref_sk.state(), "score_label": "l2=1"}
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        mon = ScoreDistributionMonitor("default", reference=reference)
+        assert mon.drift() is None  # no scores yet: nothing to judge
+        mon.publish_gauges()
+        g = telemetry.gauge("serving.model.default.score_drift_psi")
+        assert g.calls == 0  # never set: the SLO sees no traffic
+        mon.observe(rng.normal(3.0, 1, 4000))  # shifted
+        d = mon.drift()
+        assert d["psi"] > 0.25 and d["ks"] > 0.2
+        mon.publish_gauges()
+        assert g.value == pytest.approx(d["psi"], rel=0.2)
+        # non-finite scores are counted (at the deferred flush a read
+        # triggers), never raised
+        mon.observe(np.array([np.nan, np.inf, 1.0]))
+        snap = mon.snapshot()
+        assert mon.non_finite == 2
+        assert snap["non_finite_scores"] == 2
+        assert snap["drift"]["psi"] > 0.25
+        assert snap["reference"] is None  # no score_summary embedded
+    finally:
+        telemetry.disable()
+
+
+def test_score_monitor_without_reference_still_sketches(rng):
+    mon = ScoreDistributionMonitor("m")
+    mon.observe(rng.normal(0, 1, 100))
+    assert mon.drift() is None
+    assert mon.snapshot()["scores"]["moments"]["count"] == 100
+
+
+# -- SLO value objective ----------------------------------------------------
+
+def test_slo_value_objective_parse_and_burn():
+    o = parse_slo("drift=value:serving.model.default.score_drift_psi<=0.25")
+    assert isinstance(o, ValueObjective)
+    assert o.name == "drift" and o.max_value == 0.25
+    assert "score_drift_psi" in o.describe()
+    auto = parse_slo("value:data.dist.label_p99<=10")
+    assert auto.name == "value_data_dist_label_p99"
+    with pytest.raises(ValueError):
+        parse_slo("value:<=0.25")
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        tracker = SLOTracker([o])
+        ev = tracker.evaluate()["drift"]
+        # gauge never set: no traffic, no burn, compliant
+        assert ev["burn_rate"] is None and ev["compliant"] is True
+        assert ev["kind"] == "value" and ev["max_value"] == 0.25
+        telemetry.gauge(o.gauge).set(0.5)
+        ev = tracker.evaluate()["drift"]
+        assert ev["burn_rate"] == pytest.approx(2.0)
+        assert ev["compliant"] is False and ev["current"] == 0.5
+        assert telemetry.counter("slo.drift.violations").value == 1
+        telemetry.gauge(o.gauge).set(0.1)
+        ev = tracker.evaluate()["drift"]
+        assert ev["burn_rate"] == pytest.approx(0.4)
+        assert ev["compliant"] is True
+    finally:
+        telemetry.disable()
+
+
+# -- /distz + scrape hooks --------------------------------------------------
+
+def test_distz_route_and_scrape_hooks(rng):
+    telemetry.reset()
+    telemetry.enable()
+    hook_runs = {"n": 0}
+
+    def hook():
+        hook_runs["n"] += 1
+
+    mon = StreamingDistributionMonitor(feature_shards=["global"])
+    mon.observe_batch(_batch(rng, n=12))
+    srv = ObservabilityServer(port=0)
+    srv.add_distribution_provider("training", mon.snapshot)
+    srv.add_distribution_provider("broken", lambda: 1 / 0)
+    srv.add_scrape_hook("refresh", hook)
+    srv.add_scrape_hook("hook_broken", lambda: 1 / 0)
+    try:
+        with srv:
+            port = srv.port
+
+            def get(route):
+                return urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{route}", timeout=5)
+
+            dz = json.loads(get("/distz").read())
+            assert dz["training"]["rows"] == 12
+            assert dz["training"]["columns"]["label"]["quantiles"][
+                "count"] == 12
+            # provider errors are isolated + named, like /statusz
+            assert "ZeroDivisionError" in dz["broken"]["error"]
+            assert hook_runs["n"] == 1
+            # hooks also run on /metrics and /statusz; hook errors are
+            # isolated and counted
+            get("/metrics")
+            sz = json.loads(get("/statusz").read())
+            assert hook_runs["n"] == 3
+            assert sz["scrape_hook_errors"]["hook_broken"] == 3
+            assert telemetry.counter(
+                "obs.scrape_hook_errors").value == 3
+            # /distz is a first-class route (404 list carries it)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/nope")
+            assert "/distz" in json.loads(ei.value.read())["routes"]
+    finally:
+        telemetry.disable()
+
+
+# -- CLI acceptance ---------------------------------------------------------
+
+def _write_scaled_fe_avro(path, scale=1.0, n=240, d=30, per_row=4):
+    """Deterministic fixed-effect avro whose feature VALUES scale by
+    ``scale`` — scaled scores shift the score distribution, which is
+    what the drift acceptance run needs."""
+    w = np.random.default_rng(7).normal(0, 1, d + 1)
+    r = np.random.default_rng(1)
+    records = []
+    for i in range(n):
+        idx = r.choice(d, size=per_row, replace=False)
+        vals = r.normal(0, 1, per_row) * scale
+        z = float(vals @ w[idx] + w[-1])
+        records.append({
+            "uid": f"u{i}",
+            "label": float(r.random() < 1 / (1 + np.exp(-z))),
+            "features": [{"name": f"f{j}", "term": None,
+                          "value": float(v)} for j, v in zip(idx, vals)],
+            "weight": None, "offset": None, "metadataMap": None})
+    path.mkdir(parents=True, exist_ok=True)
+    write_container(path / "part-00000.avro", schemas.TRAINING_EXAMPLE,
+                    records)
+
+
+def test_distmon_requires_stream_modes(tmp_path):
+    train = tmp_path / "train"
+    _write_scaled_fe_avro(train, n=40)
+    with pytest.raises(ValueError, match="--distmon"):
+        game_training_driver.run(
+            ["--train-input-dirs", str(train), "--output-dir",
+             str(tmp_path / "o")] + _STREAM_BASE + ["--distmon"])
+
+
+def test_stream_train_distmon_snapshot_residency_independent(tmp_path):
+    """Acceptance: the data_quality sketch state is bitwise-identical
+    across resident/spill/feeder/prefetch configs (state_sha256 — the
+    same fixed-shard-order discipline as the model bytes), the
+    metrics.json block carries sketch summaries + convergence tails +
+    headline gauges, and the model artifact carries the reference
+    snapshot (label + training-score quantiles)."""
+    train = tmp_path / "train"
+    _write_scaled_fe_avro(train, n=300)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64", "--distmon"]
+    runs = {
+        "resident": base,
+        "spill": base + ["--hbm-budget", "8K"],
+        "spill_py_nopf": base + ["--hbm-budget", "8K", "--feeder",
+                                 "python", "--prefetch-batches", "0"],
+    }
+    summaries = {}
+    for tag, argv in runs.items():
+        summaries[tag] = game_training_driver.run(
+            argv + ["--output-dir", str(tmp_path / tag)])
+    hashes = {s["data_quality"]["state_sha256"]
+              for s in summaries.values()}
+    assert len(hashes) == 1, summaries.keys()
+    dq = summaries["spill"]["data_quality"]
+    assert dq["rows"] == 300
+    assert dq["columns"]["label"]["quantiles"]["count"] == 300
+    # 4 explicit features per row + the ingest-added intercept column
+    assert dq["feature_shards"]["global"]["moments"]["count"] == 1500
+    # spill path rings live through the solver hook (step recorded)
+    (ring,) = dq["convergence"].values()
+    assert ring["recorded"] >= 2
+    assert any(e["step"] is not None for e in ring["tail"])
+    # λ label carries the training-score sketch
+    (score_key,) = dq["training_scores"].keys()
+    assert dq["training_scores"][score_key]["quantiles"]["count"] == 300
+    # headline gauges were mirrored into the registry snapshot
+    gauges = summaries["spill"]["telemetry"]["metrics"]["gauges"]
+    assert gauges["data.dist.rows"] == 300
+    assert gauges["data.dist.label_mean"] == pytest.approx(
+        dq["columns"]["label"]["moments"]["mean"])
+    # reference snapshot stamped into the artifact, loadable state
+    meta = json.loads(
+        (tmp_path / "spill" / "best" / "model-metadata.json").read_text())
+    ref = meta["referenceDistributions"]
+    assert ref["version"] == 1 and ref["rows"] == 300
+    assert QuantileSketch.from_state(ref["label"]).count == 300
+    assert QuantileSketch.from_state(ref["score"]).count == 300
+    # resident and spill paths sketch scores from different surfaces
+    # (one matvec vs final margins) — both must agree with each other
+    # closely since the models match to f32 tolerance
+    res_meta = json.loads(
+        (tmp_path / "resident" / "best" /
+         "model-metadata.json").read_text())
+    a = QuantileSketch.from_state(ref["score"])
+    b = QuantileSketch.from_state(res_meta["referenceDistributions"]
+                                  ["score"])
+    assert abs(a.quantile(0.5) - b.quantile(0.5)) <= \
+        0.05 * max(1e-9, abs(a.quantile(0.5)))
+    # distmon off: no data_quality block, no reference in the artifact
+    plain = game_training_driver.run(
+        ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+            "--stream-train", "--batch-rows", "64",
+            "--output-dir", str(tmp_path / "plain")])
+    assert "data_quality" not in plain
+    meta_plain = json.loads(
+        (tmp_path / "plain" / "best" / "model-metadata.json").read_text())
+    assert "referenceDistributions" not in meta_plain
+
+
+def test_stream_train_mf_distmon_counts_rows_once(tmp_path, rng):
+    """Streamed MF re-decodes the container once per feature pass —
+    the monitor observes exactly ONE pass (max_passes=1), so rows
+    count once; entity heavy hitters ride the id column; the MF
+    reference is label-only (no cheap training-score surface)."""
+    from tests.test_cli_drivers import _MF_STREAM_BASE, _write_mf_avro
+
+    train = tmp_path / "train"
+    _write_mf_avro(train, rng, n=240)
+    s = game_training_driver.run(
+        ["--train-input-dirs", str(train)] + _MF_STREAM_BASE + [
+            "--output-dir", str(tmp_path / "o"),
+            "--stream-train", "--batch-rows", "64", "--distmon"])
+    dq = s["data_quality"]
+    assert dq["rows"] == 240
+    assert dq["columns"]["label"]["moments"]["count"] == 240
+    (etype,) = dq["entities"].keys()
+    assert dq["entities"][etype]["total"] == 240
+    meta = json.loads(
+        (tmp_path / "o" / "best" / "model-metadata.json").read_text())
+    ref = meta["referenceDistributions"]
+    assert "score" not in ref and ref["rows"] == 240
+
+
+@pytest.mark.needs_f64
+def test_serve_drift_acceptance(tmp_path):
+    """Acceptance: a --serve --distmon run drift-scores live scores
+    against the model-embedded reference — PSI stays ~0 on unshifted
+    traffic and crosses the 0.25 threshold on shifted traffic, and the
+    --slo value objective burns on exactly the shifted run (no new
+    alerting code). --stream gets the same sketch at its settle."""
+    train = tmp_path / "train"
+    shifted = tmp_path / "shifted"
+    _write_scaled_fe_avro(train, n=240)
+    _write_scaled_fe_avro(shifted, scale=4.0, n=240)
+    model_out = tmp_path / "model"
+    game_training_driver.run(
+        ["--train-input-dirs", str(train), "--output-dir",
+         str(model_out)] + _STREAM_BASE + [
+            "--stream-train", "--batch-rows", "64", "--distmon"])
+
+    def serve(inp, out):
+        return game_scoring_driver.run([
+            "--input-dirs", str(inp),
+            "--game-model-input-dir", str(model_out / "best"),
+            "--output-dir", str(out), "--serve", "--distmon",
+            "--request-rows", "4", "--serve-concurrency", "8",
+            "--slo",
+            "drift=value:serving.model.default.score_drift_psi<=0.25"])
+
+    same = serve(train, tmp_path / "sv_same")
+    moved = serve(shifted, tmp_path / "sv_shift")
+    d_same = same["distributions"]["default"]["drift"]
+    d_moved = moved["distributions"]["default"]["drift"]
+    assert d_same["psi"] < 0.1 < 0.25 < d_moved["psi"]
+    assert d_same["rows"] == d_moved["rows"] == 240
+    assert same["slo"]["drift"]["compliant"] is True
+    assert moved["slo"]["drift"]["compliant"] is False
+    assert moved["slo"]["drift"]["violations"] >= 1
+    # engine stats carry the sketch; frontend block nests it
+    eng_stats = moved["frontend"]["engines"]["default"]
+    assert eng_stats["score_distribution"]["scores"]["moments"][
+        "count"] == 240
+    # --stream path: same monitor at the stream settle
+    st = game_scoring_driver.run([
+        "--input-dirs", str(shifted),
+        "--game-model-input-dir", str(model_out / "best"),
+        "--output-dir", str(tmp_path / "st"), "--stream", "--distmon"])
+    assert st["distributions"]["default"]["drift"]["psi"] > 0.25
+    # --distmon without --stream/--serve is a typed CLI error
+    with pytest.raises(SystemExit, match="--distmon"):
+        game_scoring_driver.run([
+            "--input-dirs", str(train),
+            "--game-model-input-dir", str(model_out / "best"),
+            "--output-dir", str(tmp_path / "bad"), "--distmon"])

@@ -118,5 +118,47 @@ def test_exemplar_declaration_conflict_flagged(tmp_path):
     assert violations(tmp_path, ok) == []
 
 
+def test_gauge_only_dist_family(tmp_path):
+    # TP: data.dist.* as counter / histogram is flagged
+    for bad in ('counter("data.dist.rows")',
+                'histogram("data.dist.label_p50")'):
+        out = violations(tmp_path, TELEM + bad + "\n")
+        assert any(rule == "gauge-only-family" for rule, _ in out), bad
+    # the f-string form of the family is caught too (prefix-anchored
+    # on the leading fragment)
+    out = violations(tmp_path,
+                     TELEM + 'counter(f"data.dist.{col}_p50")\n')
+    assert any(rule == "gauge-only-family" for rule, _ in out)
+    # FP guards: gauges in the family are the contract; neighboring
+    # non-family names keep their kinds; a fragment merely CONTAINING
+    # the prefix mid-name is a different namespace
+    ok = (TELEM +
+          'gauge("data.dist.rows")\n'
+          'gauge("data.dist.label_p99")\n'
+          'counter("data.shard_cache.hits")\n'
+          'counter("data.distance_unrelated")\n'
+          'counter(f"{ns}.metadata.dist.errors")\n')
+    assert violations(tmp_path, ok) == []
+
+
+def test_gauge_only_drift_family_fragments(tmp_path):
+    # TP: the per-model f-string form — literal FRAGMENTS carry the
+    # score_drift_ marker even though the label is dynamic
+    bad = TELEM + 'counter(f"serving.model.{label}.score_drift_psi")\n'
+    out = violations(tmp_path, bad)
+    assert any(rule == "gauge-only-family" for rule, _ in out)
+    # full-literal drift names as non-gauges are flagged too
+    out = violations(
+        tmp_path,
+        TELEM + 'histogram("serving.model.a.score_drift_ks")\n')
+    assert any(rule == "gauge-only-family" for rule, _ in out)
+    # FP guards: drift gauges (literal and f-string) are clean
+    ok = (TELEM +
+          'gauge(f"serving.model.{label}.score_drift_psi")\n'
+          'gauge("serving.model.a.score_drift_ks")\n'
+          'counter(f"serving.model.{label}.rejected")\n')
+    assert violations(tmp_path, ok) == []
+
+
 def test_repo_tree_is_clean():
     assert metric_names.main(["--root", str(REPO)]) == 0

@@ -37,7 +37,8 @@ def _scrape_while_alive(out_dir, results):
         try:
             for route, key in (("/metrics", "metrics"),
                                ("/healthz", "healthz"),
-                               ("/statusz", "statusz")):
+                               ("/statusz", "statusz"),
+                               ("/distz", "distz")):
                 r = urllib.request.urlopen(
                     f"http://127.0.0.1:{port}{route}", timeout=5)
                 assert r.status == 200
@@ -96,6 +97,89 @@ def test_serve_with_obs_port_answers_live(tmp_path, rng):
     if statusz["status"].get("frontend"):
         fe = statusz["status"]["frontend"]
         assert "pending_by_model" in fe and "cache" in fe
+
+
+def test_stream_train_distmon_distz_live(tmp_path, rng):
+    """Acceptance: /distz serves LIVE label/feature distributions during
+    a --stream-train --distmon run (scraped while the driver solves),
+    and the data.dist.* headline gauges ride the live /metrics
+    exposition via the scrape-hook refresh."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300, d=40)
+    out = tmp_path / "distmon-live"
+    out.mkdir()
+    results = {}
+    scraper = threading.Thread(
+        target=_scrape_while_alive, args=(out, results), daemon=True)
+    scraper.start()
+    summary = game_training_driver.run([
+        "--train-input-dirs", str(train),
+        "--output-dir", str(out),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:15,1e-7,1.0,1.0,LBFGS,L2",
+        "--updating-sequence", "fixed",
+        "--stream-train", "--batch-rows", "64",
+        "--hbm-budget", "8K", "--distmon", "--obs-port", "0",
+    ])
+    scraper.join(timeout=60)
+    assert "error" not in results
+    assert results.get("scrapes", 0) >= 1
+    distz = json.loads(results["distz"])
+    assert "training" in distz, sorted(distz)
+    tr = distz["training"]
+    assert tr["rows"] >= 1  # live mid-run (last scrape sees it full)
+    assert tr["columns"]["label"]["quantiles"]["count"] == tr["rows"]
+    assert "global" in tr["feature_shards"]
+    # headline gauges were refreshed onto the live /metrics exposition
+    fams = parse_prometheus(results["metrics"])
+    assert "data_dist_rows" in fams
+    assert fams["data_dist_rows"]["samples"][0][2] >= 1
+    # and the final summary agrees with the plane
+    assert summary["data_quality"]["rows"] == 300
+
+
+def test_serve_distmon_distz_live(tmp_path, rng):
+    """Acceptance: /distz serves the live per-model score distribution
+    during a --serve --distmon run."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=240, d=30)
+    model_out = tmp_path / "model"
+    game_training_driver.run([
+        "--train-input-dirs", str(train),
+        "--output-dir", str(model_out),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:15,1e-7,1.0,1.0,LBFGS,L2",
+        "--updating-sequence", "fixed",
+        "--stream-train", "--batch-rows", "64", "--distmon"])
+    out = tmp_path / "serve-distz"
+    out.mkdir()
+    results = {}
+    scraper = threading.Thread(
+        target=_scrape_while_alive, args=(out, results), daemon=True)
+    scraper.start()
+    summary = game_scoring_driver.run([
+        "--input-dirs", str(train),
+        "--game-model-input-dir", str(model_out / "best"),
+        "--output-dir", str(out),
+        "--serve", "--request-rows", "4", "--serve-concurrency", "8",
+        "--distmon", "--obs-port", "0",
+    ])
+    scraper.join(timeout=60)
+    assert "error" not in results
+    assert results.get("scrapes", 0) >= 1
+    distz = json.loads(results["distz"])
+    assert "serving" in distz, sorted(distz)
+    mon = distz["serving"]["default"]
+    assert mon["scores"]["moments"]["count"] >= 0  # live snapshot
+    assert summary["distributions"]["default"]["scores"]["moments"][
+        "count"] == 240
+    # drift against the embedded reference rode along (same input ->
+    # compliant-low PSI)
+    assert summary["distributions"]["default"]["drift"]["psi"] < 0.25
 
 
 def test_driver_fault_dumps_flight_json(tmp_path, rng):

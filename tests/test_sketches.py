@@ -1,0 +1,282 @@
+"""telemetry/sketches.py: merge associativity and order-determinism
+(bitwise-equal serialized state across merge trees), quantile error
+bounds vs exact order statistics on adversarial streams, heavy-hitter
+guarantees, empty/single-element sketches, drift-score math, and the
+ConvergenceRing bound."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.optimization.convergence import ConvergenceRing
+from photon_ml_tpu.telemetry.sketches import (
+    MomentsSketch,
+    QuantileSketch,
+    TopKSketch,
+    ks,
+    psi,
+    sketch_from_state,
+)
+
+
+def _adversarial_streams(rng):
+    """Streams picked to stress the bucket grid: heavy ties, 40 orders
+    of magnitude of dynamic range, signed mixtures, sorted/reversed
+    order, near-zero clusters."""
+    base = np.concatenate([
+        rng.lognormal(0, 3, 4000),            # heavy right tail
+        -rng.lognormal(1, 2, 3000),           # signed
+        np.full(1500, 2.5),                   # massive tie block
+        np.zeros(800),                        # zeros
+        rng.normal(0, 1e-12, 400),            # near-zero cluster
+        10.0 ** rng.uniform(-20, 20, 300),    # 40 decades
+    ])
+    shuffled = base.copy()
+    rng.shuffle(shuffled)
+    return {
+        "shuffled": shuffled,
+        "sorted": np.sort(base),
+        "reversed": np.sort(base)[::-1],
+        "ties_only": np.full(997, -7.25),
+    }
+
+
+def _exact_quantile(sorted_vals, q):
+    return sorted_vals[int(np.floor(q * (len(sorted_vals) - 1)))]
+
+
+def test_quantile_relative_error_bound_adversarial():
+    rng = np.random.default_rng(7)
+    alpha = 0.01
+    for name, data in _adversarial_streams(rng).items():
+        sk = QuantileSketch(alpha)
+        sk.update(data)
+        exact = np.sort(data)
+        for q in (0.0, 0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0):
+            e = _exact_quantile(exact, q)
+            est = sk.quantile(q)
+            # Relative VALUE bound (rank selection is exact, the
+            # in-bucket representative is alpha-accurate); near zero
+            # the bound degrades to the bucket span around zero, so
+            # allow a small absolute epsilon there.
+            assert abs(est - e) <= alpha * abs(e) + 1e-11, \
+                f"{name}: q={q} exact={e} est={est}"
+
+
+def test_quantile_extremes_and_ties_exact():
+    sk = QuantileSketch()
+    data = np.array([5.0, -3.0, 5.0, 5.0, 8.5])
+    sk.update(data)
+    assert sk.quantile(0.0) == -3.0
+    assert sk.quantile(1.0) == 8.5
+    ties = QuantileSketch()
+    ties.update(np.full(100, 4.25))
+    for q in (0.0, 0.3, 0.5, 1.0):
+        assert ties.quantile(q) == pytest.approx(4.25, rel=0.01)
+
+
+def test_empty_and_single_element_sketches():
+    q = QuantileSketch()
+    assert q.count == 0 and q.quantile(0.5) is None
+    assert q.summary()["count"] == 0
+    m = MomentsSketch()
+    assert m.mean is None and m.variance is None
+    t = TopKSketch(4)
+    assert t.items() == [] and t.error_bound() == 0
+    # single element: every quantile is the element, exactly
+    q.update([3.7])
+    for p in (0.0, 0.5, 1.0):
+        assert q.quantile(p) == 3.7
+    m.update([3.7])
+    assert m.mean == 3.7 and m.variance == 0.0 and m.nnz == 1
+    # empty UPDATE payloads are no-ops
+    q.update(np.zeros(0))
+    m.update([])
+    assert q.count == 1 and m.count == 1
+    # round-trip through state keeps everything
+    assert sketch_from_state(q.state()).serialize() == q.serialize()
+    assert sketch_from_state(m.state()).serialize() == m.serialize()
+
+
+def test_non_finite_rejected():
+    for sk in (QuantileSketch(), MomentsSketch()):
+        with pytest.raises(ValueError):
+            sk.update([1.0, float("nan")])
+        with pytest.raises(ValueError):
+            sk.update([float("inf")])
+
+
+@pytest.mark.parametrize("cls", [QuantileSketch, MomentsSketch])
+def test_merge_tree_bitwise_determinism(cls):
+    """The core mergeability contract: ANY merge tree over the same
+    sub-sketches — left fold, right fold, balanced, permuted — yields
+    bitwise-identical serialized state, equal to single-stream
+    ingestion of the same update sequence."""
+    rng = np.random.default_rng(3)
+    data = _adversarial_streams(rng)["shuffled"]
+    chunks = np.array_split(data, 7)
+
+    def build(chunk):
+        s = cls()
+        s.update(chunk)
+        return s
+
+    # single stream, one update per chunk (the monitor's shape)
+    single = cls()
+    for c in chunks:
+        single.update(c)
+
+    left = build(chunks[0])
+    for c in chunks[1:]:
+        left.merge(build(c))
+
+    right = build(chunks[-1])
+    for c in chunks[-2::-1]:
+        # right-leaning tree: merge accumulated INTO each new left node
+        node = build(c)
+        node.merge(right)
+        right = node
+
+    parts = [build(c) for c in chunks]
+    t1 = parts[3].merge(parts[5])
+    t2 = parts[1].merge(parts[0]).merge(parts[6])
+    balanced = t1.merge(t2).merge(parts[2].merge(parts[4]))
+
+    blobs = {s.serialize() for s in (single, left, right, balanced)}
+    assert len(blobs) == 1
+    # and the canonical digest matches a state round-trip
+    restored = sketch_from_state(single.state())
+    assert restored.serialize() == single.serialize()
+
+
+def test_moments_adversarial_magnitudes_exact():
+    """Float reassociation is exactly what the Fraction accumulator
+    removes: 1e16 + 1 - 1e16 ACROSS updates keeps the 1.0 in every
+    merge order, where float partial sums would lose it in most orders.
+    (Within one update the contribution is one correctly-rounded fsum —
+    rounding there is deterministic, not reassociation.)"""
+    payloads = [[1e16], [1.0], [-1e16], [2.5], [1e-30], [-2.5]]
+    import itertools
+
+    blobs = set()
+    means = set()
+    for perm in itertools.permutations(range(len(payloads))):
+        m = MomentsSketch()
+        for i in perm:
+            part = MomentsSketch()
+            part.update(payloads[i])
+            m.merge(part)
+        blobs.add(m.serialize())
+        means.add(m.mean)
+    assert len(blobs) == 1
+    (mean,) = means
+    assert mean == pytest.approx((1.0 + 1e-30) / 6)
+    m = MomentsSketch()
+    m.update(np.array([1.0, 2.0, 3.0, 4.0]))
+    assert m.mean == 2.5
+    assert m.variance == pytest.approx(np.var([1, 2, 3, 4], ddof=1))
+    assert m.nnz == 4 and m.count == 4
+
+
+def test_quantile_merge_accuracy_matches_single_pass():
+    rng = np.random.default_rng(11)
+    data = rng.lognormal(0, 2, 20_000)
+    merged = QuantileSketch()
+    for chunk in np.array_split(data, 13):
+        part = QuantileSketch()
+        part.update(chunk)
+        merged.merge(part)
+    exact = np.sort(data)
+    for q in (0.1, 0.5, 0.9, 0.99):
+        e = _exact_quantile(exact, q)
+        assert abs(merged.quantile(q) - e) <= 0.01 * abs(e)
+
+
+def test_merge_rejects_mismatched_grids():
+    with pytest.raises(ValueError):
+        QuantileSketch(0.01).merge(QuantileSketch(0.02))
+    with pytest.raises(ValueError):
+        TopKSketch(4).merge(TopKSketch(8))
+
+
+def test_heavy_hitter_guarantees():
+    """Misra-Gries: every key with true frequency > n/(k+1) survives;
+    stored counts undercount by at most error_bound() <= n/(k+1)."""
+    rng = np.random.default_rng(5)
+    k = 8
+    true = {"whale": 500, "shark": 300, "tuna": 150}
+    noise = [f"minnow{i}" for i in range(400)]
+    stream = sum(([key] * c for key, c in true.items()), []) + noise
+    rng.shuffle(stream)
+    tk = TopKSketch(k)
+    for chunk in np.array_split(np.asarray(stream), 11):
+        tk.update(chunk)
+    n = tk.total
+    assert n == len(stream)
+    assert tk.error_bound() <= n / (k + 1)
+    stored = dict(tk.items())
+    for key, c in true.items():
+        if c > n / (k + 1):
+            assert key in stored, key
+            assert 0 <= c - stored[key] <= tk.error_bound()
+    # merge keeps the combined guarantee
+    a, b = TopKSketch(k), TopKSketch(k)
+    a.update(np.asarray(stream[: len(stream) // 2]))
+    b.update(np.asarray(stream[len(stream) // 2:]))
+    a.merge(b)
+    assert a.total == n
+    assert a.error_bound() <= n / (k + 1) + n / (k + 1)
+    merged = dict(a.items())
+    assert "whale" in merged
+    assert 0 <= true["whale"] - merged["whale"] <= a.error_bound()
+
+
+def test_topk_fixed_order_determinism():
+    rng = np.random.default_rng(9)
+    keys = rng.choice([f"e{i}" for i in range(50)], 3000)
+    chunks = np.array_split(keys, 7)
+
+    def run():
+        t = TopKSketch(6)
+        for c in chunks:
+            t.update(c)
+        return t.serialize()
+
+    assert run() == run()
+
+
+def test_drift_scores():
+    rng = np.random.default_rng(2)
+    ref = QuantileSketch(0.02)
+    ref.update(rng.normal(0, 1, 20_000))
+    same = QuantileSketch(0.02)
+    same.update(rng.normal(0, 1, 20_000))
+    shifted = QuantileSketch(0.02)
+    shifted.update(rng.normal(2.0, 1, 20_000))
+    p_same, p_shift = psi(ref, same), psi(ref, shifted)
+    assert p_same < 0.05 < p_shift
+    assert p_shift > 0.25  # the conventional "major shift" threshold
+    k_same, k_shift = ks(ref, same), ks(ref, shifted)
+    assert 0.0 <= k_same < 0.05
+    assert 0.2 < k_shift <= 1.0
+    # identical sketches: exactly zero drift
+    assert psi(ref, ref) == pytest.approx(0.0, abs=1e-12)
+    assert ks(ref, ref) == 0.0
+    # empty side: nothing to judge
+    assert psi(ref, QuantileSketch(0.02)) is None
+    assert ks(QuantileSketch(0.02), ref) is None
+    # state-dict operands (the model-artifact form) work identically
+    assert psi(ref.state(), shifted.state()) == pytest.approx(p_shift)
+
+
+def test_convergence_ring_bounded_and_threadsafe_snapshot():
+    ring = ConvergenceRing(capacity=8)
+    for i in range(20):
+        ring.append(i, 100.0 - i, 1.0 / (i + 1), 0.5)
+    snap = ring.snapshot()
+    assert snap["recorded"] == 20
+    assert len(snap["tail"]) == 8
+    assert snap["tail"][-1] == {"iteration": 19, "value": 81.0,
+                                "grad_norm": 1.0 / 20, "step": 0.5}
+    assert snap["tail"][0]["iteration"] == 12  # oldest retained
+    with pytest.raises(ValueError):
+        ConvergenceRing(capacity=0)
