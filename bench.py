@@ -2011,6 +2011,28 @@ def _stream_train_child(cfg: dict) -> None:
     obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
     out = {"mode": mode}
 
+    # cfg["obs_dir"]: expose this child's live plane (telemetry on, an
+    # ObservabilityServer serving /snapshotz, the obs_port descriptor
+    # announced in that dir) so a parent FleetAggregator can scrape it
+    # WHILE the mode runs — the fan-in overhead pair in
+    # federation_bench. The server dies with the process.
+    obs_srv = None
+    if cfg.get("obs_dir"):
+        from pathlib import Path as _Path
+
+        from photon_ml_tpu import telemetry as _telemetry
+        from photon_ml_tpu.telemetry import (
+            ObservabilityServer,
+            write_obs_descriptor,
+        )
+
+        _telemetry.enable()
+        obs_srv = ObservabilityServer(port=0, role="bench_child")
+        obs_srv.start()
+        obs_srv.set_ready(True, "bench_child_up")
+        write_obs_descriptor(_Path(cfg["obs_dir"]) / "obs_port",
+                             obs_srv.port, role="bench_child")
+
     imap = build_index_map(path)
     maps = {"global": imap}
     coef = jnp.zeros((len(imap),), jnp.float32)
@@ -2118,7 +2140,41 @@ def _stream_train_child(cfg: dict) -> None:
             "iteration_rows_per_sec": round(rows / pass_dt),
         })
     out["peak_rss_mb"] = _peak_rss_mb()
+    if obs_srv is not None:
+        out["obs_port"] = obs_srv.port
     print(json.dumps(out))
+
+
+def _fed_replica_child(cfg: dict) -> None:
+    """One scoring-replica stand-in for the federation replica harness
+    (ROADMAP item 3's N-replica substrate): enables telemetry, observes
+    a DETERMINISTIC per-replica latency set into the shared-ladder
+    request histogram, serves /snapshotz, announces itself with the
+    obs_port descriptor, then lingers until the parent kills it."""
+    from pathlib import Path
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry import (
+        ObservabilityServer,
+        write_obs_descriptor,
+    )
+
+    idx = int(cfg["index"])
+    n_obs = int(cfg.get("observations", 200))
+    telemetry.enable()
+    h = telemetry.histogram("serving.frontend.request_latency_seconds")
+    for j in range(n_obs):
+        # deterministic, replica-dependent spread across the ladder
+        h.observe(0.0004 * ((j % 37) + 1) * (idx + 1))
+    telemetry.counter("serving.frontend.admitted").inc(n_obs)
+    srv = ObservabilityServer(port=0, role="replica",
+                              labels={"replica": str(idx)})
+    srv.start()
+    srv.set_ready(True, "replica_up")
+    write_obs_descriptor(Path(cfg["dir"]) / "obs_port", srv.port,
+                         role="replica")
+    print(json.dumps({"replica": idx, "port": srv.port}), flush=True)
+    time.sleep(float(cfg.get("linger_s", 300.0)))
 
 
 def stream_training_bench():
@@ -3013,6 +3069,226 @@ def distmon_bench():
     }
 
 
+def federation_bench():
+    """Fleet observability federation (docs/OBSERVABILITY.md
+    §Federation): (1) merge cost vs snapshot size — synthetic 8-peer
+    fleets with growing histogram-family counts, every family carrying
+    the full fixed-ladder bucket state; (2) scrape fan-in overhead on a
+    LIVE forced-2-device mesh spill child that serves /snapshotz while
+    it solves, aggregator polling on vs off in order-balanced pairs
+    under a < 2% gate; (3) the N-replica harness (ROADMAP item 3's
+    substrate): real replica subprocesses, asserting the fleet latency
+    histogram equals the bucket-EXACT elementwise sum of the
+    per-process /snapshotz states."""
+    import shutil
+    import statistics
+    import tempfile
+    import urllib.request
+    from pathlib import Path
+
+    from photon_ml_tpu.telemetry import federation as fed
+    from photon_ml_tpu.telemetry.registry import DEFAULT_LATENCY_BUCKETS
+    from photon_ml_tpu.utils.virtual_devices import forced_cpu_device_env
+
+    try:
+        cpu_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cpu_cores = os.cpu_count() or 1
+
+    # -- (1) merge cost vs snapshot size ----------------------------------
+    bounds = [float(b) for b in DEFAULT_LATENCY_BUCKETS]
+    nb = len(bounds) + 1
+    rnd = np.random.default_rng(7)
+
+    def synth_fleet(n_peers, n_families):
+        snaps = {}
+        for p in range(n_peers):
+            hists, counters, gauges = {}, {}, {}
+            for fidx in range(n_families):
+                fam = f"bench.family_{fidx:03d}"
+                counts = rnd.integers(0, 50, size=nb)
+                hists[fam + ".latency_seconds"] = {
+                    "bounds": bounds,
+                    "counts": [int(c) for c in counts],
+                    "count": int(counts.sum()),
+                    "sum": float(counts.sum()) * 0.01,
+                    "min": 0.001, "max": 2.0, "exemplars": {}}
+                counters[fam + ".events"] = int(rnd.integers(0, 1000))
+                gauges[fam + ".level"] = {"value": float(rnd.random()),
+                                          "calls": 1}
+            snaps[f"replica-{p}@{9000 + p}"] = {
+                "schema": fed.SNAPSHOT_SCHEMA,
+                "process": {"pid": p, "role": "replica", "host": "h",
+                            "start_unix": 0.0,
+                            "snapshot_unix": 1000.0 + p, "labels": {}},
+                "counters": counters, "gauges": gauges,
+                "histograms": hists, "sketches": {}, "slo_specs": [],
+                "traces": {"sampling_enabled": False, "seen": 0,
+                           "kept": {}, "traces": {}},
+                "stages": {}}
+        return snaps
+
+    merge_cost = []
+    for n_families in (4, 16, 64):
+        snaps = synth_fleet(8, n_families)
+        fed.merge_snapshots(snaps)  # warm
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            view = fed.merge_snapshots(snaps)
+        dt_ms = (time.perf_counter() - t0) / reps * 1e3
+        probe = "bench.family_000.latency_seconds"
+        assert view.registry.histogram(probe).count == sum(
+            s["histograms"][probe]["count"] for s in snaps.values())
+        merge_cost.append({
+            "peers": 8, "histogram_families": n_families,
+            "buckets_per_histogram": nb,
+            "merge_ms": round(dt_ms, 3),
+            "us_per_family_peer": round(dt_ms * 1e3 / (8 * n_families),
+                                        2)})
+
+    # -- (2) scrape fan-in overhead on a live mesh child ------------------
+    full = SHAPE_SCALE == "full"
+    path, rows, d, per_row = _stream_train_problem(full)
+    batch_rows = 16_384 if full else 4_096
+    approx_feature_bytes = 12 * (per_row + 1) * rows
+    budget = max(1, int(0.4 * approx_feature_bytes))
+    work = Path(tempfile.mkdtemp(prefix="photon_fed_"))
+    runs = {"n": 0}
+    scrape_counts = []
+
+    def mesh_child(scraped: bool) -> float:
+        """One forced-2-device spill child exposing /snapshotz; when
+        scraped, a live aggregator polls it every 100 ms for the whole
+        run. Returns the child's cached-iteration rows/sec (its own
+        steady-state number — startup excluded)."""
+        runs["n"] += 1
+        obs_dir = work / f"obs_{runs['n']}"
+        obs_dir.mkdir()
+        cfg = {"mode": "spill", "path": path, "rows": rows,
+               "batch_rows": batch_rows, "hbm_budget_bytes": budget,
+               "mesh_devices": 2, "obs_dir": str(obs_dir)}
+        env = forced_cpu_device_env(2, os.environ)
+        env["PHOTON_BENCH_STREAM_TRAIN_CHILD"] = json.dumps(cfg)
+        agg = None
+        if scraped:
+            agg = fed.FleetAggregator(peer_dirs=[obs_dir],
+                                      interval_s=0.1)
+            agg.start()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=3600,
+                check=True)
+        finally:
+            if agg is not None:
+                agg.stop()
+        if scraped:
+            s = agg.summary()
+            scrape_counts.append(sum(p["scrapes"]
+                                     for p in s["peers"].values()))
+        child = json.loads(out.stdout.strip().splitlines()[-1])
+        return float(child["cached_iteration_rows_per_sec"])
+
+    mesh_child(False)  # warm page cache + compile cache
+    fanin_pairs = []
+    for k in range(2):
+        first = (k % 2 == 1)  # scraped-first on odd pairs
+        a = mesh_child(first)
+        b = mesh_child(not first)
+        off_v, on_v = (a, b) if first is False else (b, a)
+        fanin_pairs.append((off_v, on_v))
+    fanin_overhead = statistics.median(
+        1.0 - on / off for off, on in fanin_pairs)
+
+    # -- (3) N-replica harness: fleet == bucket-exact sum -----------------
+    n_replicas = 3
+    obs_per = 200
+    harness = work / "replicas"
+    harness.mkdir()
+    hname = "serving.frontend.request_latency_seconds"
+    procs = []
+    try:
+        for i in range(n_replicas):
+            rdir = harness / f"r{i}"
+            rdir.mkdir()
+            cfg = {"index": i, "dir": str(rdir),
+                   "observations": obs_per, "linger_s": 300.0}
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PHOTON_BENCH_FED_REPLICA=json.dumps(cfg))
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        agg = fed.FleetAggregator(peer_dirs=[harness], interval_s=0.2)
+        deadline = time.time() + 180
+        fresh = 0
+        while time.time() < deadline:
+            agg.poll_once()
+            staleness = agg.peer_staleness()
+            fresh = sum(1 for s in staleness.values() if not s["stale"])
+            if fresh >= n_replicas:
+                break
+            time.sleep(0.2)
+        view = agg.view()
+        fleet_state = view.registry.histogram(hname).state()
+        # pull each replica's own /snapshotz and sum buckets by hand —
+        # the fleet histogram must agree with that sum EXACTLY
+        want = [0] * len(fleet_state["counts"])
+        per_replica = {}
+        for peer_id, st in sorted(agg.peer_staleness().items()):
+            with urllib.request.urlopen(st["url"] + "/snapshotz",
+                                        timeout=10) as resp:
+                snap = json.loads(resp.read().decode())
+            hs = snap["histograms"][hname]
+            want = [a + b for a, b in zip(want, hs["counts"])]
+            per_replica[peer_id] = hs["count"]
+        bucket_exact = (fleet_state["counts"] == want
+                        and fleet_state["count"]
+                        == sum(per_replica.values()))
+        fleet_admitted = view.registry.counter(
+            "serving.frontend.admitted").value
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=30)
+    shutil.rmtree(work, ignore_errors=True)
+
+    return {
+        "merge_cost": merge_cost,
+        "fanin_overhead_frac": round(fanin_overhead, 4),
+        "fanin_pairs_rows_per_sec": [[round(a, 1), round(b, 1)]
+                                     for a, b in fanin_pairs],
+        "fanin_scrapes_per_run_min": (min(scrape_counts)
+                                      if scrape_counts else 0),
+        "under_2pct_gate": bool(fanin_overhead < 0.02),
+        "replica_harness": {
+            "replicas": n_replicas,
+            "fresh_at_check": fresh,
+            "observations_per_replica": obs_per,
+            "fleet_histogram_count": fleet_state["count"],
+            "per_replica_counts": per_replica,
+            "bucket_exact": bool(bucket_exact),
+            "fleet_admitted_total": fleet_admitted,
+        },
+        "cpu_cores": cpu_cores,
+        "note": "merge_cost: pure-python merge_snapshots over synthetic "
+                "8-peer fleets (full fixed-ladder bucket states). "
+                "fanin: order-balanced paired on/off — the on side runs "
+                "a live FleetAggregator polling the mesh child's "
+                f"/snapshotz at 10 Hz; on this {cpu_cores}-core host "
+                "the parent's poll loop timeshares the core with the "
+                "child, so the fraction includes BOTH the child's "
+                "scrape handling and the aggregator's own cost — an "
+                "upper bound on what a real fleet pays per child. "
+                "replica_harness: N real replica subprocesses; "
+                "bucket_exact certifies fleet buckets == elementwise "
+                "sum of per-process /snapshotz states "
+                "(docs/OBSERVABILITY.md §Federation).",
+    }
+
+
 def main():
     _enable_compile_cache()
     child_cfg = os.environ.get("PHOTON_BENCH_STREAM_TRAIN_CHILD")
@@ -3026,6 +3302,12 @@ def main():
         # Subprocess mode: one mf_training measurement (see
         # mf_training_bench) — same per-mode RSS isolation.
         _mf_train_child(json.loads(mf_child_cfg))
+        return
+    fed_replica_cfg = os.environ.get("PHOTON_BENCH_FED_REPLICA")
+    if fed_replica_cfg:
+        # Subprocess mode: one federation replica-harness child (see
+        # federation_bench) — serves /snapshotz until killed.
+        _fed_replica_child(json.loads(fed_replica_cfg))
         return
     if os.environ.get("PHOTON_BENCH_CPU_BASELINE") == "1":
         # Subprocess mode: measure the CPU baseline (1 iteration). The env
@@ -3184,6 +3466,7 @@ def main():
     stream_scoring = _try(stream_scoring_bench, {"note": "failed"})
     stream_training = _try(stream_training_bench, {"note": "failed"})
     mf_training = _try(mf_training_bench, {"note": "failed"})
+    federation = _try(federation_bench, {"note": "failed"})
     # LAST of the in-process extras: the drift-acceptance half runs the
     # scoring driver in-process, which enables x64 on CPU for the rest
     # of this process (the earlier extras' dtype assumptions must not
@@ -3309,6 +3592,7 @@ def main():
             "stream_training": stream_training,
             "mf_training": mf_training,
             "distmon": distmon,
+            "federation": federation,
             "aot_v5e_cost": aot_cost,
             "shape_scale": SHAPE_SCALE,
             "vs_baseline_note": "amortized-10it rate vs the amortized "

@@ -48,6 +48,23 @@ Scope and mechanics:
   break the ``--slo`` value-objective contract and every dashboard
   rate() built on the family. Checked on full literals AND on literal
   fragments of partially-dynamic names (the per-model f-string form).
+- The ``fleet.`` prefix is RESERVED for the fleet aggregator
+  (telemetry/federation.py): a peer process emitting ``fleet.*`` would
+  collide with the aggregator's synthesized series on the merged
+  /metrics and break per-process attribution — no file other than
+  federation.py may register a name (or literal fragment) starting
+  ``fleet.`` (docs/OBSERVABILITY.md §Federation).
+- Every GAUGE family must carry a DECLARED merge policy in
+  federation.py's ``GAUGE_MERGE_POLICIES`` (exact name, ``prefix.`` or
+  ``.suffix`` entry): gauges — unlike counters and histograms — have no
+  single correct cross-process merge, and the runtime default of
+  ``last`` silently picks "newest snapshot wins" for an undeclared
+  family. A new gauge must state whether it sums (bytes held), maxes
+  (uptime, burn rates) or follows the newest writer. Full literals must
+  resolve against the declared table; partially-dynamic names need at
+  least one literal fragment covered by an entry. Skipped entirely
+  when the tree has no ``photon_ml_tpu/telemetry/federation.py`` (TP/FP
+  tmp-tree tests supply their own).
 
 Exit 0 = clean. Run via tests.sh or directly:
     python dev_scripts/metric_names.py [--root DIR] [paths...]
@@ -91,6 +108,80 @@ def _gauge_only_family(text: str, is_fragment: bool):
         if hit:
             return label
     return None
+
+
+#: Path (relative parts) of the one module allowed to emit ``fleet.*``.
+_FEDERATION_PARTS = ("telemetry", "federation.py")
+
+
+def _is_federation_file(path: Path) -> bool:
+    return tuple(path.parts[-2:]) == _FEDERATION_PARTS
+
+
+def load_gauge_policies(root: Path):
+    """Parse ``GAUGE_MERGE_POLICIES`` (a pure dict literal) out of the
+    tree's federation module without importing it. Returns the dict, or
+    None when the module (or the table) is absent — the gauge-policy
+    rule is then skipped, which lets the TP/FP tmp-tree tests declare
+    their own minimal table."""
+    fed = root / "photon_ml_tpu" / "telemetry" / "federation.py"
+    if not fed.is_file():
+        return None
+    try:
+        tree = ast.parse(fed.read_text(encoding="utf-8"),
+                         filename=str(fed))
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # NAME: Dict[...] = {...}
+            targets = [node.target]
+        else:
+            continue
+        for tgt in targets:
+            if (isinstance(tgt, ast.Name)
+                    and tgt.id == "GAUGE_MERGE_POLICIES"
+                    and isinstance(node.value, ast.Dict)):
+                out = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            and isinstance(v, ast.Constant)):
+                        out[k.value] = v.value
+                return out
+    return None
+
+
+def _policy_covers_name(name: str, policies: dict) -> bool:
+    """A FULL literal gauge name resolves to a declared policy entry
+    (exact > ``.suffix`` endswith > ``prefix.`` startswith — the same
+    precedence the runtime resolver uses)."""
+    if name in policies:
+        return True
+    for key in policies:
+        if key.startswith(".") and name.endswith(key):
+            return True
+        if key.endswith(".") and name.startswith(key):
+            return True
+    return False
+
+
+def _policy_covers_fragment(frag: str, policies: dict) -> bool:
+    """One literal fragment of a partially-dynamic gauge name is
+    covered: it matches an exact entry, ends with a ``.suffix`` entry's
+    text (dot optional — ``pre + "burn_rate"`` fragments carry no
+    leading dot), or overlaps a ``prefix.`` entry in either
+    direction."""
+    if frag in policies:
+        return True
+    for key in policies:
+        if key.startswith(".") and frag.endswith(key[1:]):
+            return True
+        if key.endswith(".") and (frag.startswith(key)
+                                  or key.startswith(frag)):
+            return True
+    return False
 
 
 def _telemetry_bare_names(tree: ast.AST) -> set:
@@ -137,7 +228,8 @@ def _exemplars_kwarg(node: ast.Call):
     return None
 
 
-def check_file(path: Path, src: str, registrations: dict) -> list:
+def check_file(path: Path, src: str, registrations: dict,
+               gauge_policies: dict = None) -> list:
     """Violations in one file; literal registrations accumulate into
     ``registrations`` (name -> {kind: first location}, with histogram
     kinds split into ``histogram``/``histogram_exemplars`` so an
@@ -189,6 +281,26 @@ def check_file(path: Path, src: str, registrations: dict) -> list:
                         "readings refreshed on scrape "
                         "(docs/OBSERVABILITY.md §Distributions & "
                         "drift)"))
+                if (name.startswith("fleet.")
+                        and not _is_federation_file(path)):
+                    out.append((
+                        path, node.lineno, "fleet-prefix-reserved",
+                        f"{kind}({name!r}): the fleet.* prefix is "
+                        "reserved for the aggregator "
+                        "(telemetry/federation.py) — a peer emitting "
+                        "it would collide with the merged plane "
+                        "(docs/OBSERVABILITY.md §Federation)"))
+                if (kind == "gauge" and gauge_policies is not None
+                        and not _policy_covers_name(
+                            name, gauge_policies)):
+                    out.append((
+                        path, node.lineno, "gauge-merge-policy",
+                        f"gauge({name!r}) has no declared merge policy "
+                        "in GAUGE_MERGE_POLICIES "
+                        "(telemetry/federation.py) — the fleet merge "
+                        "would silently default to 'last' (newest "
+                        "snapshot wins); declare sum/max/last for the "
+                        "family (docs/OBSERVABILITY.md §Federation)"))
                 prev = registrations.setdefault(name, {})
                 prev.setdefault(kind, (path, node.lineno))
                 if exemplars is not None:
@@ -219,6 +331,27 @@ def check_file(path: Path, src: str, registrations: dict) -> list:
                         "(docs/OBSERVABILITY.md §Distributions & "
                         "drift)"))
                     break
+            for frag in frags:
+                if (frag.startswith("fleet.")
+                        and not _is_federation_file(path)):
+                    out.append((
+                        path, node.lineno, "fleet-prefix-reserved",
+                        f"{kind}(...{frag!r}...): the fleet.* prefix "
+                        "is reserved for the aggregator "
+                        "(telemetry/federation.py) "
+                        "(docs/OBSERVABILITY.md §Federation)"))
+                    break
+            if (kind == "gauge" and gauge_policies is not None
+                    and not any(_policy_covers_fragment(
+                        f, gauge_policies) for f in frags)):
+                out.append((
+                    path, node.lineno, "gauge-merge-policy",
+                    f"gauge(...{frags[0]!r}...) has no literal "
+                    "fragment covered by GAUGE_MERGE_POLICIES "
+                    "(telemetry/federation.py) — the fleet merge "
+                    "would silently default to 'last'; declare "
+                    "sum/max/last for the family "
+                    "(docs/OBSERVABILITY.md §Federation)"))
     return out
 
 
@@ -269,9 +402,11 @@ def main(argv) -> int:
     paths = args.paths or DEFAULT_PATHS
     registrations: dict = {}
     violations = []
+    gauge_policies = load_gauge_policies(root)
     for f in iter_py_files(root, paths):
         violations.extend(
-            check_file(f, f.read_text(encoding="utf-8"), registrations))
+            check_file(f, f.read_text(encoding="utf-8"), registrations,
+                       gauge_policies=gauge_policies))
     violations.extend(conflicting_types(registrations))
     for path, lineno, rule, msg in violations:
         print(f"{path}:{lineno}: [{rule}] {msg}")

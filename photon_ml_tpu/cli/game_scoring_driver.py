@@ -196,7 +196,8 @@ def run(argv=None) -> dict:
     # handler — before the failure).
     obs = None
     try:
-        obs = DriverObservability(args, out_dir).start()
+        obs = DriverObservability(args, out_dir,
+                                  role="scoring").start()
         # Root span: module imports, logging, and glue between the named
         # phases land in `driver` SELF time — the stage table sums to
         # the whole run (attributed_wall_frac >= 0.9 even on millisecond
@@ -260,6 +261,10 @@ def _run_scoring(args, out_dir, logger, obs) -> dict:
     with span("load_model"):
         shard_maps = load_feature_index_maps(index_dir)
         model = load_game_model(model_dir, shard_maps)
+    # Liveness vs readiness split: the model is resident, so this
+    # process can serve — /readyz flips 200 here, while /healthz was
+    # answering "alive" from the moment the server came up.
+    obs.mark_ready("model_loaded")
 
     with span("setup"):
         meta = json.loads((model_dir / "model-metadata.json").read_text())
@@ -345,6 +350,7 @@ def _attach_score_monitor(args, engine, label, reference, obs):
     engine.score_monitor = mon
     obs.add_dist_provider("serving", lambda: {label: mon.snapshot()})
     obs.add_scrape_hook("score_drift", mon.publish_gauges)
+    obs.add_sketch_provider("serving", mon.sketch_states)
     return mon
 
 

@@ -269,7 +269,8 @@ def run(argv=None) -> dict:
     obs = None
     emitter = EventEmitter()
     try:
-        obs = DriverObservability(args, out_dir, heartbeat_s=1.0).start()
+        obs = DriverObservability(args, out_dir, heartbeat_s=1.0,
+                                  role="training").start()
         for cp in (args.event_listeners or "").split(","):
             if cp.strip():
                 emitter.register_listener_by_name(cp.strip())
@@ -282,6 +283,10 @@ def run(argv=None) -> dict:
             (sequence, results, best_configs, best_result, shard_maps,
              num_rows, stream_info, distmon_out) = _run_training(
                 args, logger, task, emitter, obs)
+            # Liveness vs readiness split: /readyz flips true only
+            # after the solve succeeded (a just-booted process must
+            # not scrape ready — docs/OBSERVABILITY.md §Federation).
+            obs.mark_ready("solve_complete")
             _save_outputs(args, out_dir, logger, sequence, results,
                           best_configs, best_result, shard_maps,
                           extra_metadata=(
@@ -712,6 +717,7 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
         monitor = StreamingDistributionMonitor(feature_shards=[shard])
         obs.add_dist_provider("training", monitor.snapshot)
         obs.add_scrape_hook("distmon", monitor.publish_gauges)
+        obs.add_sketch_provider("training", monitor.sketch_states)
 
     def make_stream():
         s = BlockGameStream(
@@ -1004,6 +1010,7 @@ def _stream_train_mf(args, logger, task, fre_data, fre_opt, sequence,
             feature_shards=[shard], id_types=[re_type])
         obs.add_dist_provider("training", monitor.snapshot)
         obs.add_scrape_hook("distmon", monitor.publish_gauges)
+        obs.add_sketch_provider("training", monitor.sketch_states)
 
     def make_stream():
         s = BlockGameStream(

@@ -39,6 +39,7 @@ from photon_ml_tpu.telemetry import (
     SLOTracker,
     install_sigterm_dump,
     trace_tail,
+    write_obs_descriptor,
 )
 
 
@@ -95,8 +96,10 @@ class DriverObservability:
     """
 
     def __init__(self, args, out_dir: Path,
-                 heartbeat_s: Optional[float] = None):
+                 heartbeat_s: Optional[float] = None,
+                 role: str = "process"):
         self.out_dir = Path(out_dir)
+        self.role = role
         self.flight_path = self.out_dir / "flight.json"
         self.recorder: Optional[FlightRecorder] = (
             FlightRecorder(max_events=args.flight_events)
@@ -108,7 +111,8 @@ class DriverObservability:
             self.server = ObservabilityServer(
                 port=args.obs_port, recorder=self.recorder,
                 slo_tracker=self.slo_tracker, heartbeat_s=heartbeat_s,
-                dump_path=self.flight_path)
+                dump_path=self.flight_path, role=role,
+                slo_specs=args.slo or [])
         self._restore_sigterm: Optional[Callable[[], None]] = None
         self._fault_dumped = False
         # Scrape hooks registered by the driver (--distmon gauge
@@ -128,9 +132,28 @@ class DriverObservability:
             # Announce the bound port on disk the moment it exists: a
             # harness that launched this driver can scrape the LIVE run
             # (obs_port appears before model load / compiles) instead of
-            # discovering the port post-mortem in metrics.json.
-            (self.out_dir / "obs_port").write_text(f"{self.server.port}\n")
+            # discovering the port post-mortem in metrics.json. Since
+            # the federation PR this is a JSON descriptor
+            # ({port, pid, role, start_unix}) so a FleetAggregator can
+            # attribute the peer without racing its /healthz; legacy
+            # plain-int parsing is preserved in read_obs_descriptor.
+            write_obs_descriptor(self.out_dir / "obs_port",
+                                 self.server.port, role=self.role)
         return self
+
+    def mark_ready(self, reason: str = "ready") -> None:
+        """Flip the /readyz probe true (after model load / first
+        successful solve — the liveness/readiness split). No-op
+        without a server."""
+        if self.server is not None:
+            self.server.set_ready(True, reason)
+
+    def add_sketch_provider(self, name: str,
+                            fn: Callable[[], dict]) -> None:
+        """Expose mergeable sketch states under /snapshotz for the
+        fleet aggregator (no-op without a server)."""
+        if self.server is not None:
+            self.server.add_sketch_provider(name, fn)
 
     def add_status_provider(self, name: str,
                             fn: Callable[[], dict]) -> None:

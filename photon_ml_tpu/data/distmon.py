@@ -236,6 +236,27 @@ class StreamingDistributionMonitor:
     def state_sha256(self) -> str:
         return hashlib.sha256(self.serialize()).hexdigest()
 
+    def sketch_states(self) -> dict:
+        """Flat ``{key: sketch_state}`` map for /snapshotz federation
+        (telemetry/federation.py): dotted keys like
+        ``columns.label.quantiles`` / ``entities.<type>``, each value a
+        ``sketch_from_state``-reconstructible state dict, so the fleet
+        aggregator merges equal keys across training children with the
+        sketches' own deterministic merges."""
+        with self._lock:
+            out = {}
+            for name, col in sorted(self._columns.items()):
+                out[f"columns.{name}.moments"] = col.moments.state()
+                out[f"columns.{name}.quantiles"] = col.quantiles.state()
+            for name, col in sorted(self._shards.items()):
+                out[f"feature_shards.{name}.moments"] = \
+                    col.moments.state()
+                out[f"feature_shards.{name}.quantiles"] = \
+                    col.quantiles.state()
+            for name, sk in sorted(self._entities.items()):
+                out[f"entities.{name}"] = sk.state()
+            return out
+
     def data_quality_block(self) -> dict:
         """The metrics.json ``data_quality`` block: sketch summaries +
         per-λ convergence tails + the canonical state hash (the
@@ -431,6 +452,20 @@ class ScoreDistributionMonitor:
         if d is not None:
             self._g_psi.set(d["psi"])
             self._g_ks.set(d["ks"])
+
+    def sketch_states(self) -> dict:
+        """Flat ``{key: sketch_state}`` map for /snapshotz federation:
+        the live score sketch of this model, keyed under its label so
+        the aggregator merges same-model replicas and keeps different
+        models apart."""
+        with self._lock:
+            self._flush_locked()
+            return {
+                f"{self.label}.scores.moments":
+                    self._sketch.moments.state(),
+                f"{self.label}.scores.quantiles":
+                    self._sketch.quantiles.state(),
+            }
 
     def snapshot(self) -> dict:
         """The /distz serving payload for this model (scores, counters

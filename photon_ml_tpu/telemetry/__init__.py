@@ -56,7 +56,19 @@ from photon_ml_tpu.telemetry.slo import (
     RatioObjective,
     SLOTracker,
     ValueObjective,
+    evaluate_specs,
     parse_slo,
+)
+from photon_ml_tpu.telemetry.federation import (
+    SNAPSHOT_SCHEMA,
+    FleetAggregator,
+    FleetView,
+    MergedRegistry,
+    gauge_merge_policy,
+    merge_snapshots,
+    read_obs_descriptor,
+    registry_snapshot,
+    write_obs_descriptor,
 )
 from photon_ml_tpu.telemetry.sketches import (
     MomentsSketch,
@@ -127,10 +139,13 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "Counter",
     "ExecutableProfiler",
+    "FleetAggregator",
+    "FleetView",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "LatencyObjective",
+    "MergedRegistry",
     "MetricsRegistry",
     "MomentsSketch",
     "NOOP_CONTEXT",
@@ -138,6 +153,7 @@ __all__ = [
     "QuantileSketch",
     "RatioObjective",
     "SLOTracker",
+    "SNAPSHOT_SCHEMA",
     "TopKSketch",
     "TraceContext",
     "TraceTail",
@@ -148,14 +164,19 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "evaluate_specs",
     "export_chrome_trace",
     "gauge",
+    "gauge_merge_policy",
+    "merge_snapshots",
     "histogram",
     "install_sigterm_dump",
     "mint",
     "parse_slo",
     "prometheus_name",
+    "read_obs_descriptor",
     "registry",
+    "registry_snapshot",
     "render_prometheus",
     "reset",
     "sketch_from_state",
@@ -165,4 +186,5 @@ __all__ = [
     "timed_span",
     "trace_tail",
     "tracer",
+    "write_obs_descriptor",
 ]

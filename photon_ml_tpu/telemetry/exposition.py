@@ -217,8 +217,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                      "routes": sorted(obs._routes)}) + "\n",
                     "application/json")
                 return
-            body, ctype = route(self.headers.get("Accept", ""))
-            self._send(200, body, ctype)
+            result = route(self.headers.get("Accept", ""))
+            # Routes return (body, ctype), or (status, body, ctype)
+            # when they need a non-200 (readiness probes speak HTTP
+            # status codes — a load balancer never parses JSON).
+            if len(result) == 3:
+                status, body, ctype = result
+            else:
+                body, ctype = result
+                status = 200
+            self._send(status, body, ctype)
         except BrokenPipeError:
             pass  # scraper went away mid-response
         except Exception as e:  # noqa: BLE001 — a scrape must not crash
@@ -261,13 +269,30 @@ class ObservabilityServer:
                  status_providers: Optional[
                      Dict[str, Callable[[], dict]]] = None,
                  heartbeat_s: Optional[float] = None,
-                 dump_path=None):
+                 dump_path=None, role: str = "process",
+                 labels: Optional[Dict[str, str]] = None,
+                 slo_specs=None):
         self._host = host
         self._requested_port = int(port)
         self.recorder = recorder
         self.slo_tracker = slo_tracker
         self.heartbeat_s = heartbeat_s
         self.dump_path = dump_path
+        # Process identity for /snapshotz (telemetry/federation.py):
+        # the aggregator attributes every merged series back to
+        # role/pid, and re-evaluates the declared SLO spec STRINGS
+        # against the merged registry.
+        self.role = role
+        self.labels = dict(labels or {})
+        self.slo_specs = [str(s) for s in (slo_specs or [])]
+        # Liveness vs readiness: /healthz answers "is the process up"
+        # from the moment the server starts; /readyz answers "can it
+        # serve" and flips only when the driver calls set_ready()
+        # (model loaded / first solve done). A just-booted process is
+        # alive but NOT ready — a load balancer must not route to it.
+        self._ready = False
+        self._ready_reason = "starting"
+        self._ready_check: Optional[Callable[[], tuple]] = None
         self.scrapes = 0  # plain int: live even with telemetry disabled
         self._m_scrapes = _reg.registry().counter("observability.scrapes")
         # A /statusz provider that raises is isolated (its error reports
@@ -297,13 +322,17 @@ class ObservabilityServer:
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self._t0 = time.monotonic()
+        self._start_unix = time.time()
+        self._sketch_providers: Dict[str, Callable[[], dict]] = {}
         self._routes = {
             "/metrics": self._metrics,
             "/healthz": self._healthz,
+            "/readyz": self._readyz,
             "/statusz": self._statusz,
             "/debugz/dump": self._debugz_dump,
             "/tracez": self._tracez,
             "/distz": self._distz,
+            "/snapshotz": self._snapshotz,
         }
 
     # -- routes ------------------------------------------------------------
@@ -338,10 +367,45 @@ class ObservabilityServer:
                 "text/plain; version=0.0.4; charset=utf-8")
 
     def _healthz(self, accept: str = ""):
+        """Liveness: 200 as long as the process is up. Carries the
+        readiness flag informationally — probes that care about
+        routability must use /readyz, which speaks HTTP status."""
+        ready, reason = self.readiness()
         return (json.dumps({
             "status": "ok",
+            "ready": ready,
+            "ready_reason": reason,
+            "role": self.role,
             "uptime_seconds": round(time.monotonic() - self._t0, 3),
         }) + "\n", "application/json")
+
+    def _readyz(self, accept: str = ""):
+        """Readiness: 200 once the driver marked the process able to
+        serve (or the installed ready_check passes), 503 before — the
+        split /healthz used to blur: a just-booted process scraped
+        healthy before it could serve."""
+        ready, reason = self.readiness()
+        body = json.dumps({"ready": ready, "reason": reason}) + "\n"
+        return (200 if ready else 503, body, "application/json")
+
+    def _snapshotz(self, accept: str = ""):
+        """Canonical registry snapshot for federation — full raw
+        histogram bucket states (not cumulative), sketch states, SLO
+        spec strings and process metadata, in the
+        ``photon.obs.snapshot.v1`` schema that
+        telemetry/federation.py merges across processes. Imported
+        lazily: federation imports this module for the aggregator's
+        server."""
+        self._run_scrape_hooks()
+        fed = importlib.import_module(
+            "photon_ml_tpu.telemetry.federation")
+        snap = fed.registry_snapshot(
+            role=self.role, labels=self.labels,
+            slo_specs=self.slo_specs,
+            sketch_providers=self._sketch_providers,
+            start_unix=self._start_unix)
+        return (json.dumps(snap, default=_json_default) + "\n",
+                "application/json")
 
     def _statusz(self, accept: str = ""):
         self._run_scrape_hooks()
@@ -430,6 +494,48 @@ class ObservabilityServer:
         /statusz and /distz render, and on each heartbeat tick)."""
         self._scrape_hooks[name] = fn
 
+    def add_sketch_provider(self, name: str,
+                            fn: Callable[[], dict]) -> None:
+        """Register a sketch-state provider for /snapshotz: a zero-arg
+        callable returning ``{key: sketch_state_dict}`` (the
+        ``serialize()`` form telemetry/sketches.py reconstructs via
+        ``sketch_from_state``). Federation merges equal keys across
+        peers with the sketches' deterministic merges."""
+        self._sketch_providers[name] = fn
+
+    def add_route(self, path: str, fn) -> None:
+        """Install or override a route. ``fn(accept)`` returns
+        ``(body, ctype)`` or ``(status, body, ctype)``. The fleet
+        aggregator uses this to replace the per-process /metrics,
+        /statusz, /tracez, /distz and /snapshotz with merged views
+        while keeping the server plumbing."""
+        self._routes[path] = fn
+
+    def set_ready(self, ready: bool = True,
+                  reason: str = "ready") -> None:
+        """Flip the readiness flag (drivers call this after model load
+        / first successful solve)."""
+        self._ready = bool(ready)
+        self._ready_reason = reason
+
+    def set_ready_check(self, fn: Callable[[], tuple]) -> None:
+        """Install a dynamic readiness predicate returning
+        ``(ready, reason)`` — evaluated on every probe, overriding the
+        static flag. The aggregator's check requires >= 1 fresh peer,
+        which can flip back to not-ready when the fleet goes stale."""
+        self._ready_check = fn
+
+    def readiness(self) -> tuple:
+        """(ready, reason) — the dynamic check when installed, else
+        the static set_ready flag."""
+        if self._ready_check is not None:
+            try:
+                ready, reason = self._ready_check()
+                return bool(ready), str(reason)
+            except Exception as e:  # noqa: BLE001 — probe must answer
+                return False, f"ready_check error: {type(e).__name__}: {e}"
+        return self._ready, self._ready_reason
+
     @property
     def port(self) -> Optional[int]:
         """Bound port (survives stop(), so a driver can report it in
@@ -442,6 +548,7 @@ class ObservabilityServer:
         if self._httpd is not None:
             raise RuntimeError("observability server already started")
         self._t0 = time.monotonic()
+        self._start_unix = time.time()
         self._httpd = _ObsHTTPServer((self._host, self._requested_port),
                                      _Handler)
         self._httpd.obs = self
@@ -492,9 +599,13 @@ class ObservabilityServer:
 
     def summary(self) -> dict:
         """The metrics.json ``observability`` block."""
+        ready, reason = self.readiness()
         return {
             "port": self.port,
             "host": self._host,
+            "role": self.role,
+            "ready": ready,
+            "ready_reason": reason,
             "scrapes": self.scrapes,
             "heartbeat_s": self.heartbeat_s,
             "routes": sorted(self._routes),

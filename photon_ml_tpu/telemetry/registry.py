@@ -318,6 +318,30 @@ class Histogram:
                 out["+inf"] = ex[-1]
         return out
 
+    def state(self) -> Dict:
+        """Full raw state for federation (telemetry/federation.py): the
+        per-bucket RAW counts (not cumulative — bucket-wise addition
+        across processes is exact because every process shares the
+        fixed ladder), the exact scalars, and per-bucket exemplars
+        keyed by bucket INDEX (JSON-stable; the +inf overflow bucket is
+        the last index). One lock acquisition, so the exported state is
+        internally consistent under concurrent observation."""
+        with self._lock:
+            ex = {}
+            if self._exemplars is not None:
+                ex = {str(i): list(e)
+                      for i, e in enumerate(self._exemplars)
+                      if e is not None}
+            return {
+                "bounds": list(self._bounds),
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "exemplars": ex,
+            }
+
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * (len(self._bounds) + 1)

@@ -192,6 +192,68 @@ def _frac_over_threshold(hist: _reg.Histogram,
     return max(0.0, min(1.0, 1.0 - good / count))
 
 
+def measure_objective(o: Objective, reg) -> Tuple[Optional[float],
+                                                  Optional[float]]:
+    """(current value, burn rate) of one objective against ``reg`` — any
+    registry-shaped object (``counter``/``gauge``/``histogram``
+    accessors), so federation can point it at a merged fleet view. Burn
+    is ``None`` while the objective has no traffic to judge (no
+    observations / zero denominator / never-set gauge): no traffic
+    burns no budget."""
+    if isinstance(o, LatencyObjective):
+        hist = reg.histogram(o.histogram)
+        frac_over = _frac_over_threshold(hist, o.threshold_s)
+        if frac_over is None:
+            return None, None
+        return (hist.quantile(o.quantile),
+                frac_over / (1.0 - o.quantile))
+    if isinstance(o, ValueObjective):
+        g = reg.gauge(o.gauge)
+        if g.calls == 0:
+            return None, None  # never set: nothing to judge
+        v = g.value
+        return v, (v / o.max_value if o.max_value > 0
+                   else float("inf"))
+    den = sum(reg.counter(d).value for d in o.denominators)
+    if den <= 0:
+        return None, None
+    ratio = reg.counter(o.numerator).value / den
+    return ratio, (ratio / o.max_ratio if o.max_ratio > 0
+                   else float("inf"))
+
+
+def evaluate_specs(specs: Sequence[Union[Objective, str]],
+                   reg) -> Dict[str, dict]:
+    """Statelessly evaluate SLO specs against an arbitrary registry —
+    no registry-twin counters, no evaluation history. This is how the
+    fleet aggregator re-judges every peer-declared objective against
+    the MERGED registry: because counters sum and histogram buckets add
+    exactly, the fleet burn rate is the true whole-fleet number, not an
+    average of per-process burns."""
+    out = {}
+    for spec in specs:
+        o = parse_slo(spec) if isinstance(spec, str) else spec
+        current, burn = measure_objective(o, reg)
+        entry = {
+            "kind": ("latency" if isinstance(o, LatencyObjective)
+                     else "value" if isinstance(o, ValueObjective)
+                     else "ratio"),
+            "objective": o.describe(),
+            "current": current,
+            "burn_rate": burn,
+            "compliant": burn is None or burn <= 1.0,
+        }
+        if isinstance(o, LatencyObjective):
+            entry["quantile"] = o.quantile
+            entry["threshold_s"] = o.threshold_s
+        elif isinstance(o, ValueObjective):
+            entry["max_value"] = o.max_value
+        else:
+            entry["max_ratio"] = o.max_ratio
+        out[o.name] = entry
+    return out
+
+
 class SLOTracker:
     """Evaluates a fixed set of objectives against the process registry
     and maintains their burn-rate counters. ``evaluate()`` is called by
@@ -222,30 +284,7 @@ class SLOTracker:
             self._local[o.name] = {"evaluations": 0, "violations": 0}
 
     def _measure(self, o: Objective):
-        """(current value, burn rate) — burn ``None`` while the
-        objective has no traffic to judge (no observations / zero
-        denominator): no traffic burns no budget."""
-        reg = _reg.registry()
-        if isinstance(o, LatencyObjective):
-            hist = reg.histogram(o.histogram)
-            frac_over = _frac_over_threshold(hist, o.threshold_s)
-            if frac_over is None:
-                return None, None
-            return (hist.quantile(o.quantile),
-                    frac_over / (1.0 - o.quantile))
-        if isinstance(o, ValueObjective):
-            g = reg.gauge(o.gauge)
-            if g.calls == 0:
-                return None, None  # never set: nothing to judge
-            v = g.value
-            return v, (v / o.max_value if o.max_value > 0
-                       else float("inf"))
-        den = sum(reg.counter(d).value for d in o.denominators)
-        if den <= 0:
-            return None, None
-        ratio = reg.counter(o.numerator).value / den
-        return ratio, (ratio / o.max_ratio if o.max_ratio > 0
-                       else float("inf"))
+        return measure_objective(o, _reg.registry())
 
     def evaluate(self) -> Dict[str, dict]:
         out = {}

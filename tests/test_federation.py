@@ -1,0 +1,710 @@
+"""Fleet observability federation (photon_ml_tpu/telemetry/
+federation.py): canonical /snapshotz serialization, deterministic merge
+semantics (counters sum, histograms bucket-wise EXACT, gauges by
+declared policy, sketches order-independent, traces unioned with
+attribution, SLOs re-judged fleet-wide), obs_port descriptor parsing,
+liveness-vs-readiness, the aggregator's degrade-don't-crash behavior
+when a peer dies mid-scrape (real subprocess child), and the
+photon-obs-aggregate CLI."""
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import (
+    ObservabilityServer,
+    render_prometheus,
+)
+from photon_ml_tpu.telemetry import federation as fed
+from photon_ml_tpu.telemetry.registry import MetricsRegistry
+from photon_ml_tpu.telemetry.sketches import (
+    MomentsSketch,
+    QuantileSketch,
+    TopKSketch,
+)
+from tests.test_exposition import parse_prometheus
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def enabled():
+    """Telemetry enabled for tests that mutate (private) registries;
+    the process-global registry's contents stay untouched."""
+    telemetry.enable()
+    try:
+        yield
+    finally:
+        telemetry.disable()
+
+
+# -- snapshot-building helpers (hand-built peers give exact control
+# over snapshot_unix / calls / exemplars) ----------------------------------
+
+def make_snap(snap_unix=1000.0, pid=1, role="replica", counters=None,
+              gauges=None, histograms=None, sketches=None,
+              slo_specs=None, traces=None):
+    return {
+        "schema": fed.SNAPSHOT_SCHEMA,
+        "process": {"pid": pid, "role": role, "host": "h",
+                    "start_unix": snap_unix - 10.0,
+                    "snapshot_unix": snap_unix, "labels": {}},
+        "counters": dict(counters or {}),
+        "gauges": dict(gauges or {}),
+        "histograms": dict(histograms or {}),
+        "sketches": dict(sketches or {}),
+        "slo_specs": list(slo_specs or []),
+        "traces": traces if traces is not None else {
+            "sampling_enabled": False, "seen": 0, "kept": {},
+            "traces": {}},
+        "stages": {},
+    }
+
+
+def hstate(bounds, counts, total=None, s=0.0, mn=None, mx=None,
+           exemplars=None):
+    return {"bounds": list(bounds), "counts": list(counts),
+            "count": sum(counts) if total is None else total,
+            "sum": s, "min": mn, "max": mx,
+            "exemplars": exemplars or {}}
+
+
+# -- snapshot serialization ------------------------------------------------
+
+def test_snapshot_schema_metadata_and_json_round_trip(enabled):
+    reg = MetricsRegistry()
+    reg.counter("serving.frontend.admitted").inc(3)
+    reg.gauge("data.shard_cache.device_bytes").set(42.0)
+    reg.histogram("serving.request_latency_seconds",
+                  buckets=[0.1, 1.0]).observe(0.05)
+    snap = fed.registry_snapshot(
+        role="scoring", labels={"shard": "a"},
+        slo_specs=["p95:serving.request_latency_seconds<=1.0"],
+        registry=reg)
+    # the wire format IS json — a snapshot must round-trip losslessly
+    snap = json.loads(json.dumps(snap))
+    assert snap["schema"] == fed.SNAPSHOT_SCHEMA
+    proc = snap["process"]
+    assert proc["pid"] == os.getpid()
+    assert proc["role"] == "scoring"
+    assert proc["labels"] == {"shard": "a"}
+    assert proc["snapshot_unix"] > 0
+    assert snap["counters"]["serving.frontend.admitted"] == 3
+    g = snap["gauges"]["data.shard_cache.device_bytes"]
+    assert g["value"] == 42.0 and g["calls"] == 1
+    h = snap["histograms"]["serving.request_latency_seconds"]
+    # RAW per-bucket counts (len = bounds + 1 overflow), not cumulative
+    assert h["bounds"] == [0.1, 1.0]
+    assert h["counts"] == [1, 0, 0]
+    assert h["count"] == 1
+    assert snap["slo_specs"] == ["p95:serving.request_latency_seconds<=1.0"]
+    assert "traces" in snap and "stages" in snap
+
+
+def test_snapshot_sketch_provider_errors_reported_inline(enabled):
+    def boom():
+        raise RuntimeError("mid-teardown")
+    sk = QuantileSketch()
+    sk.update([1.0, 2.0])
+    snap = fed.registry_snapshot(
+        registry=MetricsRegistry(),
+        sketch_providers={"ok": lambda: {"k": sk.state()},
+                          "bad": boom})
+    assert "k" in snap["sketches"]["ok"]
+    assert "bad" not in snap["sketches"]
+    assert "RuntimeError" in snap["sketch_errors"]["bad"]
+
+
+# -- merge: counters + histograms are EXACT sums ---------------------------
+
+def test_counter_and_histogram_merge_is_bucketwise_exact(enabled):
+    regs = [MetricsRegistry(), MetricsRegistry(), MetricsRegistry()]
+    per_peer = [(5, [0.05, 0.5]), (7, [0.05, 5.0, 5.0]), (1, [0.5])]
+    for reg, (n, obs) in zip(regs, per_peer):
+        reg.counter("serving.frontend.admitted").inc(n)
+        h = reg.histogram("serving.request_latency_seconds",
+                          buckets=[0.1, 1.0, 10.0])
+        for v in obs:
+            h.observe(v)
+    snaps = {f"replica-{i}": fed.registry_snapshot(registry=r)
+             for i, r in enumerate(regs)}
+    view = fed.merge_snapshots(snaps)
+    assert view.notes == []
+    assert view.registry.counter("serving.frontend.admitted").value == 13
+    h = view.registry.histogram("serving.request_latency_seconds")
+    assert h.count == 6
+    assert h.sum == pytest.approx(0.05 + 0.5 + 0.05 + 5 + 5 + 0.5)
+    # fleet buckets == elementwise sum of the per-peer RAW buckets
+    want = [0, 0, 0, 0]
+    for snap in snaps.values():
+        st = snap["histograms"]["serving.request_latency_seconds"]
+        want = [a + b for a, b in zip(want, st["counts"])]
+    assert h.state()["counts"] == want == [2, 2, 2, 0]
+    # and the merged registry renders valid text format 0.0.4
+    fams = parse_prometheus(render_prometheus(registry=view.registry))
+    assert fams["serving_frontend_admitted_total"]["samples"][0][2] == 13.0
+    by_le = {la["le"]: v
+             for s, la, v in
+             fams["serving_request_latency_seconds"]["samples"]
+             if s.endswith("_bucket")}
+    assert by_le == {"0.1": 2.0, "1": 4.0, "10": 6.0, "+Inf": 6.0}
+
+
+def test_histogram_ladder_mismatch_keeps_first_and_notes():
+    a = make_snap(counters={}, histograms={
+        "h.x_seconds": hstate([0.1, 1.0], [1, 0, 0], s=0.05)})
+    b = make_snap(histograms={
+        "h.x_seconds": hstate([0.5, 2.0], [0, 1, 0], s=1.0)})
+    view = fed.merge_snapshots({"a": a, "b": b})
+    assert any("ladder mismatch" in n for n in view.notes)
+    h = view.registry.histogram("h.x_seconds")
+    assert h.state()["bounds"] == [0.1, 1.0]  # first peer's state kept
+    assert h.count == 1
+
+
+def test_merged_quantiles_use_fleet_min_max(enabled):
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.histogram("x.latency_seconds", buckets=[1.0]).observe(0.2)
+    rb.histogram("x.latency_seconds", buckets=[1.0]).observe(0.8)
+    view = fed.merge_snapshots(
+        {"a": fed.registry_snapshot(registry=ra),
+         "b": fed.registry_snapshot(registry=rb)})
+    h = view.registry.histogram("x.latency_seconds")
+    st = h.state()
+    assert st["min"] == 0.2 and st["max"] == 0.8
+    q = h.quantile(0.5)
+    assert 0.2 <= q <= 0.8
+    assert h.quantile(0.0) >= 0.2 and h.quantile(1.0) <= 0.8
+
+
+# -- merge: gauges by declared policy --------------------------------------
+
+def test_gauge_policy_resolution_precedence():
+    assert fed.gauge_merge_policy("data.dist.rows") == "sum"  # exact
+    assert fed.gauge_merge_policy("data.dist.label_mean") == "last"
+    assert fed.gauge_merge_policy("slo.x.burn_rate") == "max"  # suffix
+    assert fed.gauge_merge_policy(
+        "data.factor_cache.device_bytes") == "sum"  # prefix
+    assert fed.gauge_merge_policy("process.uptime_seconds") == "max"
+    assert fed.gauge_merge_policy("totally.unknown.gauge") == "last"
+
+
+def test_gauge_merge_sum_max_and_deterministic_last():
+    snaps = {
+        "a": make_snap(snap_unix=1000.0, gauges={
+            "data.dist.rows": {"value": 10.0, "calls": 2},
+            "slo.x.burn_rate": {"value": 0.5, "calls": 1},
+            "data.dist.label_mean": {"value": 1.0, "calls": 1},
+            "never.set_gauge": {"value": 99.0, "calls": 0},
+        }),
+        "b": make_snap(snap_unix=2000.0, gauges={
+            "data.dist.rows": {"value": 32.0, "calls": 4},
+            "slo.x.burn_rate": {"value": 2.5, "calls": 1},
+            "data.dist.label_mean": {"value": 7.0, "calls": 1},
+            "never.set_gauge": {"value": 7.0, "calls": 0},
+        }),
+    }
+    view = fed.merge_snapshots(snaps)
+    reg = view.registry
+    assert reg.gauge("data.dist.rows").value == 42.0          # sum
+    assert reg.gauge("slo.x.burn_rate").value == 2.5          # max
+    # "last" = newest snapshot_unix among peers that SET the gauge
+    assert reg.gauge("data.dist.label_mean").value == 7.0
+    # never set anywhere (calls == 0 everywhere) -> 0.0, not garbage
+    assert reg.gauge("never.set_gauge").value == 0.0
+
+
+def test_gauge_last_tie_breaks_on_greatest_peer_id():
+    snaps = {
+        "a": make_snap(snap_unix=1000.0,
+                       gauges={"x.g": {"value": 1.0, "calls": 1}}),
+        "b": make_snap(snap_unix=1000.0,
+                       gauges={"x.g": {"value": 2.0, "calls": 1}}),
+    }
+    # equal snapshot_unix: the greatest peer id wins, both insertion
+    # orders agree
+    v1 = fed.merge_snapshots(dict(snaps))
+    v2 = fed.merge_snapshots(dict(reversed(list(snaps.items()))))
+    assert v1.registry.gauge("x.g").value == 2.0
+    assert v2.registry.gauge("x.g").value == 2.0
+
+
+def test_gauge_last_ignores_peers_that_never_set():
+    snaps = {
+        "a": make_snap(snap_unix=1000.0,
+                       gauges={"x.g": {"value": 5.0, "calls": 3}}),
+        # newest snapshot, but never actually set the gauge
+        "b": make_snap(snap_unix=9000.0,
+                       gauges={"x.g": {"value": 0.0, "calls": 0}}),
+    }
+    assert fed.merge_snapshots(snaps).registry.gauge("x.g").value == 5.0
+
+
+# -- merge: exemplars ------------------------------------------------------
+
+def test_exemplar_merge_newest_wins_tie_smallest_trace_id():
+    ha = hstate([0.1, 1.0], [1, 1, 0], s=0.6, mn=0.05, mx=0.5,
+                exemplars={"0": ["tr-bbb", 0.05, 100.0],
+                           "1": ["tr-old", 0.5, 50.0]})
+    hb = hstate([0.1, 1.0], [1, 1, 0], s=0.6, mn=0.04, mx=0.7,
+                exemplars={"0": ["tr-aaa", 0.04, 100.0],   # ts tie
+                           "1": ["tr-new", 0.7, 200.0]})   # newer
+    view = fed.merge_snapshots({
+        "a": make_snap(histograms={"x.latency_seconds": ha}),
+        "b": make_snap(histograms={"x.latency_seconds": hb})})
+    ex = view.registry.histogram("x.latency_seconds").state()["exemplars"]
+    assert ex["0"] == ["tr-aaa", 0.04, 100.0]  # tie -> smallest id
+    assert ex["1"] == ["tr-new", 0.7, 200.0]   # newest ts wins
+    # permuting peer ids over the same states changes nothing
+    view2 = fed.merge_snapshots({
+        "b": make_snap(histograms={"x.latency_seconds": ha}),
+        "a": make_snap(histograms={"x.latency_seconds": hb})})
+    assert (view2.registry.histogram("x.latency_seconds")
+            .state()["exemplars"] == ex)
+
+
+# -- merge: sketches -------------------------------------------------------
+
+def _three_peer_sketches(rng_seed=0):
+    import random
+    rnd = random.Random(rng_seed)
+    peers = []
+    for i in range(3):
+        q, m, t = QuantileSketch(), MomentsSketch(), TopKSketch(k=16)
+        vals = [rnd.uniform(0, 10) for _ in range(50)]
+        q.update(vals)
+        m.update(vals)
+        t.update([f"e{rnd.randrange(8)}" for _ in range(50)])
+        peers.append({"dist": {"v.quantiles": q.state(),
+                               "v.moments": m.state(),
+                               "v.topk": t.state()}})
+    return peers
+
+
+def test_sketch_merge_independent_of_snapshot_arrival_order():
+    peers = _three_peer_sketches()
+    ids = ["p0", "p1", "p2"]
+    baseline = None
+    # permute dict INSERTION order while keeping the id->snapshot
+    # mapping fixed: the merged states must be byte-identical
+    for perm in itertools.permutations(range(3)):
+        snaps = {}
+        for j in perm:
+            snaps[ids[j]] = make_snap(pid=j, sketches=peers[j])
+        merged = json.dumps(fed.merge_snapshots(snaps).sketches,
+                            sort_keys=True)
+        if baseline is None:
+            baseline = merged
+        assert merged == baseline, f"order {perm} changed the merge"
+
+
+def test_commutative_sketches_independent_of_peer_assignment():
+    # quantile/moments merges are associative+commutative: even
+    # re-assigning which PEER ID carries which snapshot (which changes
+    # the fold order of the underlying states) cannot change a byte
+    peers = _three_peer_sketches()
+    for p in peers:  # drop the (order-dependent-by-nature) topk
+        del p["dist"]["v.topk"]
+    digests = set()
+    for perm in itertools.permutations(range(3)):
+        snaps = {f"p{i}": make_snap(pid=i, sketches=peers[j])
+                 for i, j in enumerate(perm)}
+        digests.add(json.dumps(fed.merge_snapshots(snaps).sketches,
+                               sort_keys=True))
+    assert len(digests) == 1
+
+
+def test_sketch_merge_matches_direct_merge():
+    peers = _three_peer_sketches()
+    view = fed.merge_snapshots(
+        {f"p{i}": make_snap(pid=i, sketches=p)
+         for i, p in enumerate(peers)})
+    direct = QuantileSketch.from_state(peers[0]["dist"]["v.quantiles"])
+    for p in peers[1:]:
+        direct.merge(
+            QuantileSketch.from_state(p["dist"]["v.quantiles"]))
+    assert view.sketches["dist"]["v.quantiles"] == direct.state()
+
+
+def test_corrupt_sketch_state_noted_not_fatal():
+    good = QuantileSketch()
+    good.update([1.0])
+    view = fed.merge_snapshots({
+        "a": make_snap(sketches={"d": {"ok": good.state(),
+                                       "bad": {"kind": "nope"}}})})
+    assert "ok" in view.sketches["d"]
+    assert "bad" not in view.sketches["d"]
+    assert any("bad" in n for n in view.notes)
+
+
+# -- merge: traces ---------------------------------------------------------
+
+def _trace(tid, start, dur=0.01):
+    return {"trace_id": tid, "kind": "request", "outcome": "ok",
+            "start_unix": start, "duration_s": dur, "events": []}
+
+
+def test_trace_merge_unions_attributes_and_caps():
+    ta = {"sampling_enabled": True, "seen": 90,
+          "kept": {"slow": 80, "error": 2},
+          "traces": {"slow": [_trace(f"a{i:03d}", 1000.0 + i)
+                             for i in range(80)],
+                     "error": [_trace("aerr", 500.0)]}}
+    tb = {"sampling_enabled": False, "seen": 70,
+          "kept": {"slow": 60},
+          "traces": {"slow": [_trace(f"b{i:03d}", 2000.0 + i)
+                             for i in range(60)]}}
+    view = fed.merge_snapshots({"a": make_snap(traces=ta),
+                                "b": make_snap(traces=tb)})
+    tr = view.traces
+    assert tr["sampling_enabled"] is True
+    assert tr["seen"] == 160
+    assert tr["kept"] == {"slow": 140, "error": 2}
+    assert set(tr["peers"]) == {"a", "b"}
+    slow = tr["traces"]["slow"]
+    assert len(slow) == fed.MERGED_TRACE_RING  # 140 capped to 128
+    # newest first; the newest trace fleet-wide is b's last
+    assert slow[0]["trace_id"] == "b059"
+    assert slow[0]["peer"] == "b"
+    # every retained trace carries its per-process attribution
+    assert all(t["peer"] in ("a", "b") for t in slow)
+    starts = [t["start_unix"] for t in slow]
+    assert starts == sorted(starts, reverse=True)
+    assert view.traces["traces"]["error"][0]["peer"] == "a"
+
+
+# -- merge: SLOs re-judged fleet-wide --------------------------------------
+
+def test_slo_reevaluated_on_merged_registry_not_averaged(enabled):
+    spec = "p95:serving.request_latency_seconds<=1.0"
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ha = ra.histogram("serving.request_latency_seconds",
+                      buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.05, 0.05, 5.0):   # 1/4 over -> burn 5.0 alone
+        ha.observe(v)
+    hb = rb.histogram("serving.request_latency_seconds",
+                      buckets=[0.1, 1.0, 10.0])
+    for _ in range(12):                 # 0/12 over -> burn 0.0 alone
+        hb.observe(0.05)
+    view = fed.merge_snapshots({
+        "a": fed.registry_snapshot(registry=ra, slo_specs=[spec]),
+        "b": fed.registry_snapshot(registry=rb, slo_specs=[spec])})
+    assert view.slo_specs == [spec]
+    (entry,) = view.slo.values()
+    assert entry["kind"] == "latency"
+    # the TRUE pooled number: 1 of 16 over threshold -> burn
+    # 0.0625/0.05 = 1.25 — NOT the 2.5 an average of per-peer burns
+    # would fabricate
+    assert entry["burn_rate"] == pytest.approx(1.25)
+    assert entry["compliant"] is False
+
+
+def test_slo_value_objective_over_merged_max_gauge():
+    spec = "value:serving.model.a.score_drift_psi<=0.25"
+    snaps = {
+        "a": make_snap(slo_specs=[spec], gauges={
+            "serving.model.a.score_drift_psi":
+                {"value": 0.1, "calls": 1}}),
+        "b": make_snap(slo_specs=[spec], gauges={
+            "serving.model.a.score_drift_psi":
+                {"value": 0.5, "calls": 1}}),
+    }
+    view = fed.merge_snapshots(snaps)
+    (entry,) = view.slo.values()
+    # the .score_drift_psi policy is MAX: the fleet is as drifted as
+    # its worst replica — an alert must not average away a bad one
+    assert entry["current"] == pytest.approx(0.5)
+    assert entry["compliant"] is False
+
+
+# -- merged registry zero twins + closed-under-merge -----------------------
+
+def test_merged_registry_zero_twins_for_unreported_names():
+    reg = fed.merge_snapshots({"a": make_snap()}).registry
+    assert reg.counter("never.reported").value == 0
+    assert reg.gauge("never.reported_g").value == 0.0
+    h = reg.histogram("never.reported_seconds")
+    assert h.count == 0 and h.quantile(0.5) is None
+
+
+def test_merge_is_closed_under_serialization(enabled):
+    ra, rb, rc = (MetricsRegistry() for _ in range(3))
+    for reg, n in ((ra, 3), (rb, 4), (rc, 5)):
+        reg.counter("x.events").inc(n)
+        reg.histogram("x.latency_seconds",
+                      buckets=[0.1, 1.0]).observe(0.05 * n)
+    # merge a+b, re-serialize the VIEW in the same schema, then merge
+    # that aggregate snapshot with peer c: totals must equal the flat
+    # 3-way merge — aggregators stack hierarchically
+    level1 = fed.merge_snapshots(
+        {"a": fed.registry_snapshot(registry=ra),
+         "b": fed.registry_snapshot(registry=rb)})
+    agg_snap = json.loads(json.dumps(level1.snapshot()))
+    assert agg_snap["schema"] == fed.SNAPSHOT_SCHEMA
+    assert agg_snap["process"]["merged_peers"] == ["a", "b"]
+    level2 = fed.merge_snapshots(
+        {"agg": agg_snap, "c": fed.registry_snapshot(registry=rc)})
+    flat = fed.merge_snapshots(
+        {p: fed.registry_snapshot(registry=r)
+         for p, r in (("a", ra), ("b", rb), ("c", rc))})
+    assert (level2.registry.counter("x.events").value ==
+            flat.registry.counter("x.events").value == 12)
+    assert (level2.registry.histogram("x.latency_seconds").state() ==
+            flat.registry.histogram("x.latency_seconds").state())
+
+
+def test_unknown_schema_skipped_with_note():
+    view = fed.merge_snapshots({
+        "ok": make_snap(counters={"x.n": 1}),
+        "weird": {"schema": "somebody.else.v9", "counters": {"x.n": 9}},
+    })
+    assert view.registry.counter("x.n").value == 1
+    assert any("unknown schema" in n for n in view.notes)
+    assert "weird" not in view.peers
+
+
+# -- obs_port descriptors --------------------------------------------------
+
+def test_obs_descriptor_json_round_trip(tmp_path):
+    p = tmp_path / "obs_port"
+    desc = fed.write_obs_descriptor(p, 9100, role="scoring", pid=1234,
+                                    start_unix=111.0)
+    assert desc == {"port": 9100, "pid": 1234, "role": "scoring",
+                    "start_unix": 111.0}
+    assert fed.read_obs_descriptor(p) == desc
+    # defaults: pid of the writing process, now-ish start
+    fed.write_obs_descriptor(p, 9101)
+    back = fed.read_obs_descriptor(p)
+    assert back["pid"] == os.getpid()
+    assert back["role"] == "process"
+
+
+def test_obs_descriptor_legacy_plain_int(tmp_path):
+    p = tmp_path / "obs_port"
+    p.write_text("9100\n")  # the PR 9 format
+    assert fed.read_obs_descriptor(p) == {"port": 9100}
+
+
+def test_discover_peers_scans_dir_and_children(tmp_path):
+    fed.write_obs_descriptor(tmp_path / "obs_port", 9000,
+                             role="training", pid=10)
+    for i, port in enumerate((9001, 9002)):
+        d = tmp_path / f"replica{i}"
+        d.mkdir()
+        fed.write_obs_descriptor(d / "obs_port", port, role="replica",
+                                 pid=20 + i)
+    (tmp_path / "replica2").mkdir()
+    (tmp_path / "replica2" / "obs_port").write_text("not a port\n")
+    found = fed.discover_peers([tmp_path])
+    assert sorted(found) == ["replica-20@9001", "replica-21@9002",
+                             "training-10@9000"]
+    assert found["replica-20@9001"]["url"] == "http://127.0.0.1:9001"
+
+
+# -- liveness vs readiness + /snapshotz over HTTP --------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_liveness_vs_readiness_split(enabled):
+    srv = ObservabilityServer(port=0, role="scoring")
+    srv.start()
+    try:
+        # alive from the first instant...
+        code, body = _get(srv.port, "/healthz")
+        assert code == 200
+        hz = json.loads(body)
+        assert hz["status"] == "ok"
+        assert hz["ready"] is False and hz["role"] == "scoring"
+        # ...but NOT ready until the model loads / first solve lands
+        code, body = _get(srv.port, "/readyz")
+        assert code == 503
+        assert json.loads(body)["ready"] is False
+        srv.set_ready(True, "model_loaded")
+        code, body = _get(srv.port, "/readyz")
+        assert code == 200
+        assert json.loads(body)["reason"] == "model_loaded"
+        # a dynamic readiness check wins over the static flag
+        srv.set_ready_check(lambda: (False, "draining"))
+        code, body = _get(srv.port, "/readyz")
+        assert code == 503 and json.loads(body)["reason"] == "draining"
+    finally:
+        srv.stop()
+
+
+def test_snapshotz_endpoint_serves_canonical_schema(enabled):
+    srv = ObservabilityServer(port=0, role="scoring",
+                              labels={"zone": "z1"},
+                              slo_specs=["p99:x.latency_seconds<=1s"])
+    srv.start()
+    try:
+        code, body = _get(srv.port, "/snapshotz")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["schema"] == fed.SNAPSHOT_SCHEMA
+        assert snap["process"]["role"] == "scoring"
+        assert snap["process"]["labels"] == {"zone": "z1"}
+        assert snap["slo_specs"] == ["p99:x.latency_seconds<=1s"]
+    finally:
+        srv.stop()
+
+
+# -- aggregator: peer death mid-scrape (real subprocess) -------------------
+
+_REPLICA_CHILD = """
+import sys, time
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import ObservabilityServer, \\
+    write_obs_descriptor
+
+telemetry.enable()
+telemetry.counter("serving.frontend.admitted").inc(7)
+telemetry.histogram("serving.request_latency_seconds").observe(0.05)
+srv = ObservabilityServer(port=0, role="replica")
+srv.start()
+srv.set_ready(True, "up")
+write_obs_descriptor(sys.argv[1] + "/obs_port", srv.port,
+                     role="replica")
+print("CHILD_UP", srv.port, flush=True)
+time.sleep(120)
+"""
+
+
+def _spawn_replica(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _REPLICA_CHILD, str(tmp_path)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"cannot spawn a child interpreter here: {e}")
+    deadline = time.time() + 60
+    port_file = tmp_path / "obs_port"
+    while time.time() < deadline:
+        if port_file.is_file() and port_file.read_text().strip():
+            return proc
+        if proc.poll() is not None:
+            out = proc.stdout.read() if proc.stdout else ""
+            raise AssertionError(
+                f"replica child died rc={proc.returncode}:\n{out}")
+        time.sleep(0.05)
+    proc.kill()
+    raise AssertionError("replica child never announced its port")
+
+
+def test_peer_death_mid_scrape_degrades_not_crashes(tmp_path):
+    proc = _spawn_replica(tmp_path)
+    agg = fed.FleetAggregator(peer_dirs=[tmp_path], interval_s=0.2,
+                              stale_after_s=0.3)
+    agg.server.start()  # serve merged routes; polling stays manual
+    try:
+        # first scrape: peer fresh, its numbers in the fleet totals
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            agg.poll_once()
+            stale = agg.peer_staleness()
+            if stale and all(not s["stale"] for s in stale.values()):
+                break
+            time.sleep(0.1)
+        (peer_id,) = agg.peer_staleness().keys()
+        assert peer_id.startswith("replica-")
+        assert agg._readiness()[0] is True
+        code, body = _get(agg.server.port, "/metrics")
+        assert code == 200
+        fams = parse_prometheus(body)
+        assert (fams["serving_frontend_admitted_total"]
+                ["samples"][0][2] == 7.0)
+        label = fed._peer_metric_label(peer_id)
+        assert (fams[f"fleet_peer_{label}_stale"]
+                ["samples"][0][2] == 0.0)
+
+        # kill the child BETWEEN scrapes
+        proc.kill()
+        proc.wait(timeout=30)
+        time.sleep(0.4)  # > stale_after_s
+        agg.poll_once()  # must not raise
+
+        st = agg.peer_staleness()[peer_id]
+        assert st["stale"] is True
+        assert st["errors"] >= 1 and st["last_error"]
+        assert st["staleness_seconds"] > 0.3
+        # the merged plane keeps serving, the dead peer's LAST
+        # snapshot stays in the fleet totals, and the staleness is
+        # flagged on /metrics
+        code, body = _get(agg.server.port, "/metrics")
+        assert code == 200
+        fams = parse_prometheus(body)
+        assert (fams["serving_frontend_admitted_total"]
+                ["samples"][0][2] == 7.0)
+        assert (fams[f"fleet_peer_{label}_stale"]
+                ["samples"][0][2] == 1.0)
+        assert (fams[f"fleet_peer_{label}_staleness_seconds"]
+                ["samples"][0][2] > 0.3)
+        assert fams["fleet_peers_stale"]["samples"][0][2] == 1.0
+        # /healthz stays 200 (liveness) while /readyz degrades to 503
+        code, body = _get(agg.server.port, "/healthz")
+        assert code == 200 and json.loads(body)["ready"] is False
+        code, _ = _get(agg.server.port, "/readyz")
+        assert code == 503
+        # /statusz exposes the per-process breakdown + the error
+        code, body = _get(agg.server.port, "/statusz")
+        sz = json.loads(body)
+        assert sz["peers"][peer_id]["stale"] is True
+        assert sz["peer_processes"][peer_id]["role"] == "replica"
+    finally:
+        agg.server.stop()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+
+
+# -- photon-obs-aggregate CLI ----------------------------------------------
+
+def test_obs_aggregate_cli_requires_a_peer_source():
+    from photon_ml_tpu.cli import obs_aggregate
+    with pytest.raises(SystemExit):
+        obs_aggregate.run(["--duration", "0.1"])
+
+
+def test_obs_aggregate_cli_run_over_live_peer(tmp_path, enabled):
+    from photon_ml_tpu.cli import obs_aggregate
+    peer_dir = tmp_path / "peer"
+    peer_dir.mkdir()
+    srv = ObservabilityServer(port=0, role="scoring")
+    srv.start()
+    try:
+        fed.write_obs_descriptor(peer_dir / "obs_port", srv.port,
+                                 role="scoring")
+        # scan peer_dir itself — the fleet output dir must stay out of
+        # the scanned tree or the aggregator would discover ITSELF
+        out = tmp_path / "fleet"
+        summary = obs_aggregate.run([
+            "--peer-dirs", str(peer_dir), "--interval", "0.1",
+            "--duration", "0.6", "--output-dir", str(out)])
+    finally:
+        srv.stop()
+    assert summary["scrape_passes"] >= 1
+    (peer_id,) = summary["peers"].keys()
+    assert peer_id.startswith("scoring-")
+    assert summary["peers"][peer_id]["scrapes"] >= 1
+    # the aggregator announces ITSELF with the descriptor format
+    desc = fed.read_obs_descriptor(out / "obs_port")
+    assert desc["role"] == "aggregator" and desc["port"] > 0
+    saved = json.loads((out / "fleet_summary.json").read_text())
+    assert saved["scrape_passes"] == summary["scrape_passes"]

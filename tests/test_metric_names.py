@@ -162,3 +162,88 @@ def test_gauge_only_drift_family_fragments(tmp_path):
 
 def test_repo_tree_is_clean():
     assert metric_names.main(["--root", str(REPO)]) == 0
+
+
+# -- fleet.* reservation + gauge merge policies (PR: federation) -----------
+
+def _violations(tmp_path, src, name="m.py", policies=None):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    out = metric_names.check_file(p, src, {}, gauge_policies=policies)
+    return [(rule, msg) for _, _, rule, msg in out]
+
+
+def test_fleet_prefix_reserved(tmp_path):
+    # TP: an ordinary module registering a fleet.* name (full literal
+    # and literal fragment of an f-string) collides with the merged
+    # plane's synthesized series
+    out = _violations(tmp_path, TELEM + 'gauge("fleet.peers")\n')
+    assert any(rule == "fleet-prefix-reserved" for rule, _ in out)
+    out = _violations(tmp_path,
+                      TELEM + 'gauge(f"fleet.peer.{pid}.stale")\n')
+    assert any(rule == "fleet-prefix-reserved" for rule, _ in out)
+    # FP guards: federation.py itself owns the prefix; a name merely
+    # CONTAINING "fleet." mid-name is a different namespace
+    out = _violations(tmp_path, TELEM + 'gauge("fleet.peers")\n',
+                      name="telemetry/federation.py")
+    assert not any(rule == "fleet-prefix-reserved" for rule, _ in out)
+    assert _violations(tmp_path,
+                       TELEM + 'counter("my.fleet.rows")\n') == []
+
+
+_POL = {"data.dist.rows": "sum", ".burn_rate": "max",
+        "data.dist.": "last"}
+
+
+def test_gauge_merge_policy_required(tmp_path):
+    # TP: a gauge family with no declared policy entry (full literal
+    # and a partially-dynamic name with no covered fragment)
+    out = _violations(tmp_path, TELEM + 'gauge("new.thing_bytes")\n',
+                      policies=_POL)
+    assert any(rule == "gauge-merge-policy" for rule, _ in out)
+    out = _violations(tmp_path, TELEM + 'gauge(f"new.{x}.thing")\n',
+                      policies=_POL)
+    assert any(rule == "gauge-merge-policy" for rule, _ in out)
+    # FP guards: exact, .suffix, prefix., fragment-prefix, and a
+    # concatenated fragment carrying the suffix without its dot
+    ok = (TELEM +
+          'gauge("data.dist.rows")\n'
+          'gauge("slo.x.burn_rate")\n'
+          'gauge("data.dist.label_mean")\n'
+          'gauge(f"data.dist.{col}_mean")\n'
+          'gauge(pre + "burn_rate")\n')
+    assert _violations(tmp_path, ok, policies=_POL) == []
+    # counters/histograms need no policy; rule skipped when the tree
+    # has no federation table (policies=None)
+    assert _violations(tmp_path, TELEM + 'counter("new.thing")\n',
+                       policies=_POL) == []
+    assert _violations(tmp_path, TELEM + 'gauge("new.thing")\n') == []
+
+
+def test_load_gauge_policies(tmp_path):
+    # absent module -> None (rule skipped entirely)
+    assert metric_names.load_gauge_policies(tmp_path) is None
+    # a tmp tree can declare its own minimal table
+    fedp = tmp_path / "photon_ml_tpu" / "telemetry"
+    fedp.mkdir(parents=True)
+    (fedp / "federation.py").write_text(
+        'GAUGE_MERGE_POLICIES = {"a.b.": "sum", ".c": "max"}\n')
+    assert metric_names.load_gauge_policies(tmp_path) == {
+        "a.b.": "sum", ".c": "max"}
+    # the real tree's table parses and holds only valid policies
+    real = metric_names.load_gauge_policies(REPO)
+    assert real and set(real.values()) <= {"sum", "max", "last"}
+
+
+def test_gauge_policy_rule_wired_through_main(tmp_path):
+    fedp = tmp_path / "photon_ml_tpu" / "telemetry"
+    fedp.mkdir(parents=True)
+    (fedp / "federation.py").write_text(
+        'GAUGE_MERGE_POLICIES = {"covered.": "sum"}\n')
+    (tmp_path / "bench.py").write_text("")
+    mod = tmp_path / "photon_ml_tpu" / "mod.py"
+    mod.write_text(TELEM + 'gauge("uncovered.bytes")\n')
+    assert metric_names.main(["--root", str(tmp_path)]) == 1
+    mod.write_text(TELEM + 'gauge("covered.bytes")\n')
+    assert metric_names.main(["--root", str(tmp_path)]) == 0

@@ -31,7 +31,8 @@ def _scrape_while_alive(out_dir, results):
             results["error"] = "obs_port file never appeared"
             return
         time.sleep(0.01)
-    port = int(port_file.read_text().strip())
+    from photon_ml_tpu.telemetry import read_obs_descriptor
+    port = read_obs_descriptor(port_file)["port"]
     results["port"] = port
     while True:
         try:
@@ -322,3 +323,109 @@ def test_scoring_metrics_json_includes_new_frontend_keys(tmp_path, rng):
     assert fe["admitted"] == \
         fe["completed"] + fe["failed"] + fe["cancelled"]
     np.testing.assert_equal(fe["failed"], 0)
+
+
+def _aggregate_while_alive(out_dir, results):
+    """Background fleet aggregator (telemetry/federation.py): discover
+    the driver's obs_port descriptor, poll /snapshotz, and keep the
+    last merged /metrics, /distz and /tracez bodies."""
+    from photon_ml_tpu.telemetry.federation import FleetAggregator
+
+    port_file = out_dir / "obs_port"
+    deadline = time.monotonic() + 60
+    while not port_file.exists():
+        if time.monotonic() > deadline:
+            results["error"] = "obs_port file never appeared"
+            return
+        time.sleep(0.01)
+    agg = FleetAggregator(peer_dirs=[out_dir], interval_s=0.05)
+    agg.start()
+    try:
+        while True:
+            agg.poll_once()
+            stale = agg.peer_staleness()
+            fresh = [p for p, s in stale.items() if s["has_snapshot"]]
+            if fresh and not any(s["last_error"]
+                                 for s in stale.values()):
+                try:
+                    for route, key in (("/metrics", "metrics"),
+                                       ("/distz", "distz"),
+                                       ("/tracez", "tracez"),
+                                       ("/statusz", "statusz")):
+                        r = urllib.request.urlopen(
+                            f"http://127.0.0.1:{agg.port}{route}",
+                            timeout=5)
+                        assert r.status == 200
+                        results[key] = r.read().decode()
+                    results["ready_code"] = urllib.request.urlopen(
+                        f"http://127.0.0.1:{agg.port}/readyz",
+                        timeout=5).status
+                    results["merges"] = results.get("merges", 0) + 1
+                except (urllib.error.URLError, ConnectionError,
+                        OSError):
+                    return
+            if stale and all(s["last_error"] for s in stale.values()):
+                return  # the driver's plane went away: done
+            time.sleep(0.02)
+    finally:
+        agg.stop()
+
+
+@pytest.mark.needs_f64
+def test_fleet_aggregator_over_live_training_run(tmp_path, rng):
+    """Acceptance: a FleetAggregator discovers a LIVE --stream-train
+    --distmon --obs-port run via its JSON obs_port descriptor, serves
+    merged /metrics (valid Prometheus text carrying the peer's series
+    AND the aggregator's fleet.* staleness gauges), merged /distz with
+    per-process attribution, and reports ready while the peer is
+    fresh."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300, d=40)
+    out = tmp_path / "fleet-live"
+    out.mkdir()
+    results = {}
+    agg_thread = threading.Thread(
+        target=_aggregate_while_alive, args=(out, results), daemon=True)
+    agg_thread.start()
+    game_training_driver.run([
+        "--train-input-dirs", str(train),
+        "--output-dir", str(out),
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--fixed-effect-data-configurations", "fixed:global",
+        "--fixed-effect-optimization-configurations",
+        "fixed:15,1e-7,1.0,1.0,LBFGS,L2",
+        "--updating-sequence", "fixed",
+        "--stream-train", "--batch-rows", "32", "--feeder", "python",
+        "--distmon", "--obs-port", "0"])
+    agg_thread.join(timeout=60)
+    assert "error" not in results, results.get("error")
+    assert results.get("merges", 0) >= 1
+    # Merged /metrics: valid exposition, peer's registry series summed
+    # in, and the aggregator's reserved fleet.* namespace present.
+    families = parse_prometheus(results["metrics"])
+    assert "fleet_peers" in families
+    assert "fleet_peers_fresh" in families
+    assert any(n.startswith("fleet_peer_training_")
+               for n in families), sorted(families)[:10]
+    assert any(n.startswith("data_dist_") for n in families)
+    # Merged /distz: fleet rollup + per-process breakdown, carrying
+    # the training monitor's sketch states.
+    distz = json.loads(results["distz"])
+    assert "training" in distz["fleet"]
+    assert any(k.startswith("columns.label.")
+               for k in distz["fleet"]["training"])
+    assert len(distz["peers"]) == 1
+    (peer_sketches,) = distz["peers"].values()
+    assert "training" in peer_sketches
+    # Merged /tracez: the peer's tail-sampled solves, tagged with the
+    # peer id (per-process attribution).
+    tracez = json.loads(results["tracez"])
+    assert tracez["seen"] >= 1
+    tagged = [t for ring in tracez["traces"].values() for t in ring]
+    assert tagged and all("peer" in t for t in tagged)
+    # Aggregator readiness: >= 1 fresh peer while the run was live.
+    assert results["ready_code"] == 200
+    statusz = json.loads(results["statusz"])
+    (peer_meta,) = statusz["peer_processes"].values()
+    assert peer_meta["role"] == "training"
+    assert peer_meta["pid"] > 0
