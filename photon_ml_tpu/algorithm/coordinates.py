@@ -418,6 +418,123 @@ class StreamingFixedEffectCoordinate:
         return model.update_model(new_glm), result
 
 
+def grid_batchable(configs) -> Tuple[bool, str]:
+    """Can this λ-grid run as ONE batched streamed solve
+    (:func:`solve_fixed_effect_grid`)? True only when every point is a
+    streamable L2 solve and the points are homogeneous in everything
+    but ``regularization_weight`` — the batched solvers share one
+    candidate schedule / trust-region recipe across rows, so only the
+    λ row may vary. Returns ``(ok, why_not)``."""
+    from photon_ml_tpu.optimization.config import OptimizerType
+
+    configs = list(configs)
+    if not configs:
+        return False, "empty grid"
+    base = configs[0]
+    if base.optimizer_type not in (OptimizerType.LBFGS,
+                                   OptimizerType.TRON):
+        return False, (f"streaming grid solves support LBFGS/TRON, got "
+                       f"{base.optimizer_type}")
+    for cfg in configs:
+        l1, _ = _l1_l2(cfg)
+        if l1 > 0:
+            return False, ("L1/elastic-net grid points need the "
+                           "resident path")
+        if cfg.down_sampling_rate < 1.0:
+            return False, ("down-sampling is not supported by streamed "
+                           "solves")
+        if (cfg.optimizer_type != base.optimizer_type
+                or cfg.max_iterations != base.max_iterations
+                or cfg.tolerance != base.tolerance):
+            return False, (
+                "grid points must share optimizer type, max_iterations "
+                "and tolerance to batch — only the regularization "
+                "weight may vary across rows")
+    return True, ""
+
+
+def solve_fixed_effect_grid(
+    coordinate: StreamingFixedEffectCoordinate,
+    configs,
+    models=None,
+    trace_ctxs=None,
+    convergence_rings=None,
+    margins_out=None,
+) -> List[Tuple[FixedEffectModel, OptimizerResult]]:
+    """Solve a whole λ-grid in ONE batched streamed sweep: coefficients
+    stack to ``[G, d]`` and every feature pass of the underlying grid
+    solver (optimization/glm_lbfgs.py `minimize_lbfgs_glm_grid_streaming`
+    / tron.py `minimize_tron_grid_streaming`) advances all G points —
+    a sweep costs the slowest row's pass count instead of the sum over
+    rows (~G× less decode+H2D traffic).
+
+    ``coordinate`` supplies the cache/objective/task (its own config
+    must be one of the homogeneous grid's shapes); ``configs`` is the
+    λ-grid (validated via :func:`grid_batchable` — ValueError with the
+    reason when not batchable). ``models`` warm-starts per row
+    (row-aligned list, entries may be None). ``trace_ctxs`` /
+    ``convergence_rings`` / ``margins_out`` thread through to the grid
+    solver (per-row observability; ``margins_out`` receives the
+    ``[G, rows]`` per-shard margins — slice rows out with
+    ``ShardedGLMObjective.grid_row_margins``).
+
+    Returns a row-aligned list of ``(FixedEffectModel, OptimizerResult)``
+    — the same pairs G sequential ``coordinate.solve`` calls produce.
+    G=1 delegates to the scalar streamed solver inside the grid solver
+    (bitwise gate), so this entry point is safe for any grid size.
+    """
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.optimization.config import OptimizerType
+    from photon_ml_tpu.optimization.glm_lbfgs import (
+        minimize_lbfgs_glm_grid_streaming,
+    )
+    from photon_ml_tpu.optimization.tron import minimize_tron_grid_streaming
+
+    configs = list(configs)
+    ok, why = grid_batchable(configs)
+    if not ok:
+        raise ValueError(f"λ-grid is not batchable: {why}")
+    G = len(configs)
+    if models is None:
+        models = [None] * G
+    models = [m if m is not None else coordinate.initialize_model()
+              for m in models]
+    if len(models) != G:
+        raise ValueError(
+            f"models must be row-aligned with the grid (G={G}), got "
+            f"{len(models)}")
+
+    dtype = coordinate.dtype
+    x0s = jnp.stack([jnp.asarray(m.glm.coefficients.means, dtype)
+                     for m in models])
+    l2s = np.asarray([_l1_l2(cfg)[1] for cfg in configs],
+                     np.dtype(dtype))
+    base = configs[0]
+    if base.optimizer_type == OptimizerType.TRON:
+        if not coordinate._objective.loss.twice_differentiable:
+            raise ValueError(
+                f"TRON requires a twice-differentiable loss, got "
+                f"{coordinate._objective.loss.name}")
+        results = minimize_tron_grid_streaming(
+            coordinate._sharded, x0s, l2s,
+            max_iter=base.max_iterations, tol=base.tolerance,
+            trace_ctxs=trace_ctxs, convergence_rings=convergence_rings,
+            margins_out=margins_out)
+    else:
+        results = minimize_lbfgs_glm_grid_streaming(
+            coordinate._sharded, x0s, l2s,
+            max_iter=base.max_iterations, tol=base.tolerance,
+            trace_ctxs=trace_ctxs, convergence_rings=convergence_rings,
+            margins_out=margins_out)
+    coordinate._sharded.assert_trace_budget()
+
+    out = []
+    for model, result in zip(models, results):
+        new_glm = model.glm.update_coefficients(Coefficients(result.x))
+        out.append((model.update_model(new_glm), result))
+    return out
+
+
 @dataclasses.dataclass
 class RandomEffectCoordinate(Coordinate):
     """Entity-sharded coordinate

@@ -172,6 +172,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "overlapped with the accumulate. Selects the "
                         "sharded streaming solve (L2 LBFGS/TRON only). "
                         "With --mesh-devices the budget is PER DEVICE")
+    p.add_argument("--grid-batched", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="batch the λ₂ grid into ONE streamed sweep "
+                        "(--stream-train --hbm-budget): coefficients "
+                        "stack to [G, d] and every feature pass over "
+                        "the shard cache advances ALL G grid points "
+                        "through vmapped per-bucket kernels, so a "
+                        "sweep costs the slowest point's pass count "
+                        "instead of the sum over points (~G× less "
+                        "decode + re-upload traffic). 'auto' (default) "
+                        "batches when the grid has > 1 point and is "
+                        "batchable (homogeneous LBFGS/TRON, L2 only); "
+                        "'on' forces batching and errors when it "
+                        "can't; 'off' keeps the sequential per-λ "
+                        "sweep. G=1 batched delegates to the scalar "
+                        "streamed solver (bit-identical model bytes), "
+                        "and exact selection ties break to the "
+                        "smallest λ on every path "
+                        "(docs/SCALE.md §Batched λ-grid)")
     p.add_argument("--mesh-devices", type=_positive_int, default=None,
                    metavar="N",
                    help="fold the --hbm-budget streaming solve over a "
@@ -394,6 +413,18 @@ def _run_training(args, logger, task, emitter, obs):
             "--mesh-devices > 1 requires --hbm-budget: the device fold "
             "runs over the sharded shard-cache solve (the resident "
             "assembled path is a single fused device batch)")
+    if args.grid_batched != "auto" and not args.stream_train:
+        raise ValueError(
+            "--grid-batched applies to the --stream-train λ-grid "
+            "sweep; pass --stream-train (the one-shot estimator "
+            "trains the grid one combination at a time)")
+    if args.grid_batched == "on" and args.hbm_budget is None:
+        raise ValueError(
+            "--grid-batched on requires --hbm-budget: the batched "
+            "sweep runs over the sharded shard-cache solve (the "
+            "resident assembled path reuses the fused one-shot "
+            "solvers, which already share the device batch across "
+            "the grid)")
     if args.spill_dtype != "f32" and args.hbm_budget is None:
         raise ValueError(
             "--spill-dtype applies to --hbm-budget spill buffers; pass "
@@ -649,6 +680,91 @@ def _stream_validate_many(game_models, args, shard_maps, evaluators,
     return metrics
 
 
+def _solve_grid_batched(args, logger, name, shard, task, grid, cache,
+                        mesh, monitor, lam_label):
+    """--grid-batched sweep: ONE StreamingFixedEffectCoordinate hosts
+    the whole λ-grid and :func:`solve_fixed_effect_grid` advances all
+    G points per feature pass over the shard cache ([G, d]
+    coefficients, vmapped per-bucket kernels). Observability stays
+    per-λ: each grid point keeps its own trace context (annotated with
+    its grid row), --distmon convergence ring, and training-score
+    sketch sliced from the batched [G, rows] margins. Returns the same
+    (configs, CoordinateDescentResult) pairs the sequential sweep
+    builds, plus the shared sharded objective for stream_info."""
+    import time as _time
+
+    from photon_ml_tpu.algorithm.coordinate_descent import (
+        CoordinateDescentResult,
+    )
+    from photon_ml_tpu.algorithm.coordinates import (
+        StreamingFixedEffectCoordinate,
+        solve_fixed_effect_grid,
+    )
+    from photon_ml_tpu.models.game_model import GameModel
+
+    G = len(grid)
+    logger.info("λ-grid sweep batched: %d points advance per feature "
+                "pass (--grid-batched %s)", G, args.grid_batched)
+    coord = StreamingFixedEffectCoordinate(
+        name=name, cache=cache, feature_shard_id=shard, task_type=task,
+        config=grid[0], mesh=mesh)
+    t0 = _time.perf_counter()
+    rings, margins_holder = None, []
+    if monitor is not None:
+        from photon_ml_tpu.optimization.convergence import ConvergenceRing
+
+        rings = []
+        for cfg in grid:
+            ring = ConvergenceRing()
+            monitor.add_ring(lam_label(cfg), ring)
+            rings.append(ring)
+    # One trace context per λ-grid point, exactly as the sequential
+    # sweep mints them — a row's divergence fault carries ITS trace_id
+    # (plus grid row + λ) into the flight dump, not the sweep's.
+    ctxs = []
+    for gi, cfg in enumerate(grid):
+        ctx = telemetry.mint("solve")
+        ctx.annotate(coordinate=name,
+                     reg_weight=cfg.regularization_weight,
+                     optimizer=str(cfg.optimizer_type),
+                     grid_row=gi, grid_width=G)
+        ctxs.append(ctx)
+    models = None
+    trackers_per = [[] for _ in grid]
+    obj_hist_per = [[] for _ in grid]
+    for _ in range(args.num_iterations):
+        pairs = solve_fixed_effect_grid(
+            coord, grid, models=models, trace_ctxs=ctxs,
+            convergence_rings=rings, margins_out=margins_holder)
+        models = [m for m, _ in pairs]
+        for gi, (_, res) in enumerate(pairs):
+            trackers_per[gi].append(res)
+            obj_hist_per[gi].append(float(res.value))
+    shared = coord.sharded_objective
+    if monitor is not None and margins_holder:
+        for gi, cfg in enumerate(grid):
+            monitor.observe_scores(
+                lam_label(cfg),
+                shared.host_scores_from_margins(
+                    shared.grid_row_margins(margins_holder, gi)))
+    elapsed = _time.perf_counter() - t0
+    results = []
+    for gi, cfg in enumerate(grid):
+        ctxs[gi].annotate(
+            iterations=int(trackers_per[gi][-1].iterations),
+            reason=trackers_per[gi][-1].reason_enum().summary)
+        ctxs[gi].finish("ok")
+        gm = GameModel({name: models[gi]}, task)
+        # The sweep IS one solve: every grid point reports the shared
+        # wall time (the whole point — G points for one sweep's clock).
+        results.append(({name: cfg}, CoordinateDescentResult(
+            model=gm, objective_history=obj_hist_per[gi],
+            validation_history=[], best_model=gm, best_metric=None,
+            trackers={name: trackers_per[gi]},
+            timings={name: elapsed})))
+    return results, shared
+
+
 def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                   train_inputs, evaluators, preloaded_maps, opt_grid,
                   emitter, obs):
@@ -674,6 +790,8 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
     )
     from photon_ml_tpu.algorithm.coordinates import (
         StreamingFixedEffectCoordinate,
+        grid_batchable,
+        solve_fixed_effect_grid,
     )
     from photon_ml_tpu.data.avro_reader import build_index_map
     from photon_ml_tpu.data.block_stream import BlockGameStream
@@ -762,6 +880,11 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "spill_source": None,
             "feeder": {k: v for k, v in data.ingest_stats.items()},
             "cache": None,
+            # The fused one-shot solvers already share the assembled
+            # device batch across the grid; batching is a spill-path
+            # concept.
+            "grid_batched": False,
+            "grid_points": len(grid),
         }
     else:
         # -- spill: sharded streaming accumulate over the device cache ----
@@ -801,8 +924,21 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
         obs.add_status_provider("shard_cache", cache.stats)
         results = []
         shared = None
+        batchable, why_not = grid_batchable(grid)
+        if args.grid_batched == "on" and not batchable:
+            raise ValueError(
+                f"--grid-batched on: λ-grid is not batchable: {why_not}")
+        use_batched = batchable and (
+            args.grid_batched == "on"
+            or (args.grid_batched == "auto" and len(grid) > 1))
+        if args.grid_batched == "auto" and len(grid) > 1 and not batchable:
+            logger.info("λ-grid sweeps sequentially (%s)", why_not)
         with span("solve"):
-            for cfg in grid:
+            if use_batched:
+                results, shared = _solve_grid_batched(
+                    args, logger, name, shard, task, grid, cache, mesh,
+                    monitor, lam_label)
+            for cfg in (() if use_batched else grid):
                 coord = StreamingFixedEffectCoordinate(
                     name=name, cache=cache, feature_shard_id=shard,
                     task_type=task, config=cfg, sharded_objective=shared,
@@ -862,6 +998,8 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "spill_source": args.spill_source,
             "feeder": cache.ingest_stats,
             "cache": cache.stats(),
+            "grid_batched": use_batched,
+            "grid_points": len(grid),
             "trace_budgets": shared.trace_budgets(),
             "trace_counts": shared.guard.counts(),
         }

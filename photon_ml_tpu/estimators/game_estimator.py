@@ -205,6 +205,25 @@ class GameEstimator:
         return select_best_result(results, self.validation_evaluators)
 
 
+def _config_lambda_key(configs: Dict[str, GLMOptimizationConfiguration]):
+    """Deterministic λ ordering key for a grid point's per-coordinate
+    config dict: the tuple of regularization weights in sorted
+    coordinate-name order. Used ONLY to break exact metric/objective
+    ties, so selection never depends on dict insertion or sweep
+    iteration order (batched and sequential sweeps enumerate the grid
+    differently)."""
+    def reg_weight(cfg) -> float:
+        rw = getattr(cfg, "regularization_weight", None)
+        if rw is None:
+            # Factored-random-effect configs nest the GLM config.
+            inner = getattr(cfg, "random_effect", None)
+            rw = getattr(inner, "regularization_weight", 0.0)
+        return float(rw)
+
+    return tuple(reg_weight(cfg)
+                 for _, cfg in sorted(configs.items()))
+
+
 def select_best_result(
     results, validation_evaluators
 ) -> Tuple[Dict[str, GLMOptimizationConfiguration],
@@ -214,7 +233,13 @@ def select_best_result(
     grid selection cannot diverge): best by the first validation
     evaluator when validation produced metrics, else lowest final
     training objective. An empty final metrics dict (e.g. an empty
-    streamed validation input) degrades to objective selection."""
+    streamed validation input) degrades to objective selection.
+
+    Tie-break (documented contract): an EXACT metric/objective tie
+    goes to the smallest λ — the tuple of regularization weights in
+    sorted coordinate-name order (``_config_lambda_key``) — so batched
+    and sequential λ-grid sweeps, whatever order they enumerate the
+    grid in, can never disagree on the selected model."""
     if not results:
         raise ValueError("no results")
     if validation_evaluators and results[0][1].validation_history \
@@ -223,7 +248,11 @@ def select_best_result(
         best = None
         for item in results:
             metric = item[1].validation_history[-1][head.name]
-            if best is None or head.better_than(metric, best[0]):
+            if best is None or head.better_than(metric, best[0]) or (
+                    metric == best[0]
+                    and _config_lambda_key(item[0])
+                    < _config_lambda_key(best[1][0])):
                 best = (metric, item)
         return best[1]
-    return min(results, key=lambda item: item[1].objective_history[-1])
+    return min(results, key=lambda item: (item[1].objective_history[-1],
+                                          _config_lambda_key(item[0])))

@@ -102,6 +102,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.ops.glm_objective import GLMBatch, GLMObjective
 from photon_ml_tpu.telemetry import span
 from photon_ml_tpu.utils.tracing_guard import TracingGuard
@@ -112,16 +113,31 @@ Array = jax.Array
 #: each traces at most once per bucket shape (see assert_trace_budget).
 KERNEL_FAMILIES = 8
 
+#: Feature passes (full decode+H2D walks over ``cache.blocks()``) made
+#: by the GRID accumulation methods — the quantity the batched λ-grid
+#: amortizes over all G points (counter sums across processes under
+#: telemetry federation; docs/OBSERVABILITY.md).
+_M_GRID_PASSES = telemetry.counter("training.grid.feature_passes")
+
 _NULL_SPAN = contextlib.nullcontext()
 
 
 class _Fold:
     """One accumulation pass's combine. `add(slot, part)` consumes the
     per-shard partials in fixed shard order; `result()` returns the
-    apex value. Subclasses implement the three combine strategies."""
+    apex value. Subclasses implement the three combine strategies.
 
-    def __init__(self, sobj: "ShardedGLMObjective"):
+    ``kits``/``combine_fn`` select which accumulate kernels fold the
+    partials — the scalar kits by default, the grid kits for `[G, ...]`
+    partials — so grid folds never feed `[G]`-shaped partials through
+    the scalar accumulators' jit caches (each kernel's trace budget
+    stays in bucket terms for ITS shapes only)."""
+
+    def __init__(self, sobj: "ShardedGLMObjective", kits=None,
+                 combine_fn=None):
         self.s = sobj
+        self.kits = kits if kits is not None else sobj._kits
+        self.combine_fn = combine_fn
         self.acc = None
 
     def result(self):
@@ -133,7 +149,7 @@ class _SingleFold(_Fold):
 
     def add(self, slot, part):
         self.acc = part if self.acc is None \
-            else self.s._kits[0]["acc"](self.acc, part)
+            else self.kits[0]["acc"](self.acc, part)
 
 
 class _OrderedFold(_Fold):
@@ -145,7 +161,7 @@ class _OrderedFold(_Fold):
         with span("cross_device_combine"):
             part = jax.device_put(part, self.s.devices[0])
             self.acc = part if self.acc is None \
-                else self.s._k_combine(self.acc, part)
+                else self.combine_fn(self.acc, part)
 
 
 class _LocalFold(_Fold):
@@ -154,13 +170,13 @@ class _LocalFold(_Fold):
     apex — D-1 transfers per pass, bounded f32 reassociation vs
     "ordered" (module docstring)."""
 
-    def __init__(self, sobj):
-        super().__init__(sobj)
+    def __init__(self, sobj, kits=None, combine_fn=None):
+        super().__init__(sobj, kits, combine_fn)
         self.accs = [None] * len(sobj.devices)
 
     def add(self, slot, part):
         self.accs[slot] = part if self.accs[slot] is None \
-            else self.s._kits[slot]["acc"](self.accs[slot], part)
+            else self.kits[slot]["acc"](self.accs[slot], part)
 
     def result(self):
         acc = None
@@ -169,7 +185,7 @@ class _LocalFold(_Fold):
                 if part is None:
                     continue
                 part = jax.device_put(part, self.s.devices[0])
-                acc = part if acc is None else self.s._k_combine(acc, part)
+                acc = part if acc is None else self.combine_fn(acc, part)
         return acc
 
 
@@ -247,6 +263,12 @@ class ShardedGLMObjective:
 
             self._k_combine = jax.jit(combine_kernel)
             self.guard.track("sharded:combine", self._k_combine)
+        # Grid kits (vmapped-over-λ twins of the scalar kernels) are
+        # built lazily on the first grid_* call: a sequential sweep
+        # never pays their compiles, and trace_budgets() only mentions
+        # kernels that exist.
+        self._grid_kits: Optional[List[Dict[str, object]]] = None
+        self._k_grid_combine = None
         # Back-compat aliases (tests poke individual kernels).
         kit0 = self._kits[0]
         self._k_init = kit0["init"]
@@ -331,6 +353,108 @@ class ShardedGLMObjective:
             self.guard.track(f"sharded:{name}{tag}", fn)
         return kit
 
+    def _build_grid_kit(self, tag: str) -> Dict[str, object]:
+        """One device's GRID kernel kit: each kernel is the scalar body
+        vmapped over a leading λ axis (coefficients `[G, d]`, margins
+        `[G, rows]`), so one decode+H2D feature pass serves every grid
+        point. The vmap closes over the per-shard feature block — the
+        block is read ONCE and broadcast across the G lanes by XLA, it
+        is never replicated in HBM. G is part of the jit signature: one
+        grid width per objective instance stays within the per-bucket
+        budgets below (a second width would trace a second executable
+        per kernel; run it on a fresh objective).
+
+        The vmapped reduces associate differently from the scalar
+        kernels' (XLA's reduce is not prefix-stable under batching), so
+        a `[1, ...]` grid row is NOT bitwise the scalar kernel — which
+        is why the grid solvers delegate G=1 to the scalar path."""
+        obj = self.objective
+
+        def grid_init_kernel(feats, labels, offsets, weights, coefs,
+                             n: int):
+            batch = GLMBatch(feats, labels, offsets, weights)
+
+            def one(coef):
+                z = obj.margins(coef, batch)
+                val = jnp.sum((weights * obj.loss.loss(z, labels))[:n])
+                u = weights * obj.loss.d1(z, labels)
+                return z, val, feats.rmatvec(u), jnp.sum(u[:n])
+
+            return jax.vmap(one)(coefs)
+
+        def grid_direction_kernel(feats, labels, offsets, weights,
+                                  directions):
+            batch = GLMBatch(feats, labels, offsets, weights)
+            return jax.vmap(
+                lambda p: obj.margin_direction(p, batch))(directions)
+
+        def grid_trial_kernel(z, zp, labels, weights, ts, n: int):
+            def one(z_g, zp_g, ts_g):
+                z_t = z_g[None, :n] + ts_g[:, None] * zp_g[None, :n]
+                return jnp.sum(
+                    weights[None, :n]
+                    * obj.loss.loss(z_t, labels[None, :n]),
+                    axis=-1)
+
+            return jax.vmap(one)(z, zp, ts)
+
+        def grid_grad_kernel(feats, labels, weights, z, n: int):
+            def one(z_g):
+                u = weights * obj.loss.d1(z_g, labels)
+                return feats.rmatvec(u), jnp.sum(u[:n])
+
+            return jax.vmap(one)(z)
+
+        def grid_curvature_kernel(z, labels, weights):
+            return jax.vmap(
+                lambda z_g: weights * obj.loss.d2(z_g, labels))(z)
+
+        def grid_hvp_kernel(feats, labels, offsets, weights, d2, vecs,
+                            n: int):
+            batch = GLMBatch(feats, labels, offsets, weights)
+
+            def one(d2_g, vec_g):
+                jv = obj.margin_direction(vec_g, batch)
+                t = d2_g * jv
+                return feats.rmatvec(t), jnp.sum(t[:n])
+
+            return jax.vmap(one)(d2, vecs)
+
+        def grid_acc_kernel(acc, part):
+            return jax.tree.map(jnp.add, acc, part)
+
+        def grid_axpy_kernel(a, t, b):
+            # Frozen grid rows carry t == 0 and their margins must stay
+            # bit-identical; a + 0*b is not a bitwise identity (-0.0 +
+            # 0.0 is +0.0, and a non-finite b would poison the row), so
+            # mask rather than rely on the zero step.
+            return jnp.where((t != 0.0)[:, None], a + t[:, None] * b, a)
+
+        kit = {
+            "init": jax.jit(grid_init_kernel, static_argnames=("n",)),
+            "dir": jax.jit(grid_direction_kernel),
+            "trial": jax.jit(grid_trial_kernel, static_argnames=("n",)),
+            "grad": jax.jit(grid_grad_kernel, static_argnames=("n",)),
+            "curv": jax.jit(grid_curvature_kernel),
+            "hvp": jax.jit(grid_hvp_kernel, static_argnames=("n",)),
+            "acc": jax.jit(grid_acc_kernel),
+            "axpy": jax.jit(grid_axpy_kernel),
+        }
+        for name, fn in kit.items():
+            self.guard.track(f"sharded:grid_{name}{tag}", fn)
+        return kit
+
+    def _ensure_grid_kits(self) -> None:
+        if self._grid_kits is not None:
+            return
+        self._grid_kits = [self._build_grid_kit(t) for t in self._tags]
+        if self.devices is not None:
+            def grid_combine_kernel(acc, part):
+                return jax.tree.map(jnp.add, acc, part)
+
+            self._k_grid_combine = jax.jit(grid_combine_kernel)
+            self.guard.track("sharded:grid_combine", self._k_grid_combine)
+
     # -- mesh plumbing -----------------------------------------------------
 
     def _per_device(self, x) -> List:
@@ -350,12 +474,16 @@ class ShardedGLMObjective:
             return _NULL_SPAN
         return span(f"device_fold:d{slot}")
 
-    def _new_fold(self) -> _Fold:
+    def _new_fold(self, grid: bool = False) -> _Fold:
+        kits = self._grid_kits if grid else self._kits
+        combine_fn = None
+        if self.devices is not None:
+            combine_fn = self._k_grid_combine if grid else self._k_combine
         if self.devices is None:
-            return _SingleFold(self)
+            return _SingleFold(self, kits)
         if self.combine == "ordered":
-            return _OrderedFold(self)
-        return _LocalFold(self)
+            return _OrderedFold(self, kits, combine_fn)
+        return _LocalFold(self, kits, combine_fn)
 
     # -- introspection -----------------------------------------------------
 
@@ -397,8 +525,24 @@ class ShardedGLMObjective:
                 f"sharded:acc{tag}": 4,
                 f"sharded:axpy{tag}": 2 * row_buckets,
             })
+            if self._grid_kits is not None:
+                # Grid kernels carry the SAME per-bucket bounds: G is a
+                # fixed leading dim of each signature (one grid width
+                # per objective instance), so compiles are flat in G.
+                budgets.update({
+                    f"sharded:grid_init{tag}": 2 * buckets,
+                    f"sharded:grid_dir{tag}": buckets,
+                    f"sharded:grid_grad{tag}": 2 * buckets,
+                    f"sharded:grid_hvp{tag}": 2 * buckets,
+                    f"sharded:grid_trial{tag}": 4 * row_buckets,
+                    f"sharded:grid_curv{tag}": row_buckets,
+                    f"sharded:grid_acc{tag}": 4,
+                    f"sharded:grid_axpy{tag}": 2 * row_buckets,
+                })
         if self.devices is not None:
             budgets["sharded:combine"] = 4
+            if self._grid_kits is not None:
+                budgets["sharded:grid_combine"] = 4
         return budgets
 
     def assert_trace_budget(self) -> None:
@@ -574,3 +718,144 @@ class ShardedGLMObjective:
                 fold.add(e.slot, part)
             r_raw, su = fold.result()
         return self._finish_grad(r_raw, su, vec, l2)
+
+    # -- grid accumulation passes (batched λ-grid, PR 16) ------------------
+    #
+    # The grid_* methods are the [G, ...] twins of the passes above: one
+    # walk over ``cache.blocks()`` — ONE decode+H2D bill — advances all G
+    # grid points at once. Margins live as [G, rows] per shard, still on
+    # the shard's own device; only [G, d] coefficient panels cross the
+    # interconnect. Every method that touches cache.blocks() increments
+    # ``training.grid.feature_passes``.
+
+    def _grid_finish_grad(self, g_raw: Array, su: Array, coefs: Array,
+                          l2s: Array) -> Array:
+        """Per-row normalization chain + L2 at the apex: `[G, d]` raw
+        gradients, `[G]` u-sums, `[G]` λ row."""
+        norm = self.objective.normalization
+        r = g_raw
+        if norm is not None:
+            if norm.shifts is not None:
+                r = r - su[:, None] * norm.shifts[None, :]
+            if norm.factors is not None:
+                r = r * norm.factors[None, :]
+        return r + l2s[:, None] * coefs
+
+    def grid_margins_value_grad(
+            self, coefs: Array, l2s: Array
+    ) -> Tuple[List[Array], Array, Array]:
+        """One feature pass for ALL grid rows: per-shard `[G, rows]`
+        margins, `[G]` objective values, `[G, d]` gradients."""
+        self._ensure_grid_kits()
+        _M_GRID_PASSES.inc()
+        z_list: List[Array] = []
+        fold = self._new_fold(grid=True)
+        with span("accumulate"):
+            cs = self._per_device(coefs)
+            for e in self.cache.blocks():
+                self._require_restored(e)
+                with self._dev_span(e.slot):
+                    z, val, g_raw, su = self._grid_kits[e.slot]["init"](
+                        e.feats, e.labels, e.offsets, e.weights,
+                        cs[e.slot], n=e.n_rows)
+                z_list.append(z)
+                fold.add(e.slot, (val, g_raw, su))
+            val, g_raw, su = fold.result()
+        f = val + 0.5 * l2s * jnp.sum(coefs * coefs, axis=-1)
+        return z_list, f, self._grid_finish_grad(g_raw, su, coefs, l2s)
+
+    def grid_margin_direction_list(self, directions: Array) -> List[Array]:
+        """Per-shard `[G, rows]` directional margins for `[G, d]` search
+        directions — one feature pass for the whole grid."""
+        self._ensure_grid_kits()
+        _M_GRID_PASSES.inc()
+        out: List[Array] = []
+        with span("accumulate"):
+            ds = self._per_device(directions)
+            for e in self.cache.blocks():
+                self._require_restored(e)
+                with self._dev_span(e.slot):
+                    out.append(self._grid_kits[e.slot]["dir"](
+                        e.feats, e.labels, e.offsets, e.weights,
+                        ds[e.slot]))
+        return out
+
+    def grid_trial_values(self, z_list: Sequence[Array],
+                          zp_list: Sequence[Array], ts: Array,
+                          coef_sq: Array, l2s: Array) -> Array:
+        """`[G, K]` objective values at per-row step candidates ``ts``
+        (`[G, K]`) — row-space only, NO feature pass: the batched Armijo
+        sweep costs the grid nothing in decode traffic."""
+        self._ensure_grid_kits()
+        fold = self._new_fold(grid=True)
+        with span("accumulate"):
+            tss = self._per_device(ts)
+            for e, z, zp in zip(self.cache.entries, z_list, zp_list):
+                with self._dev_span(e.slot):
+                    part = self._grid_kits[e.slot]["trial"](
+                        z, zp, e.labels, e.weights, tss[e.slot],
+                        n=e.n_rows)
+                fold.add(e.slot, part)
+            res = fold.result()
+        return res + 0.5 * l2s[:, None] * coef_sq
+
+    def grid_update_margins(self, z_list: Sequence[Array], t,
+                            zp_list: Sequence[Array]) -> List[Array]:
+        """z + t*zp per shard with a per-row step `[G]`; rows with
+        t == 0 (frozen masks, rejected searches) keep their margins
+        bit-identical (the grid axpy masks instead of adding 0)."""
+        self._ensure_grid_kits()
+        tss = self._per_device(t)
+        return [self._grid_kits[e.slot]["axpy"](z, tss[e.slot], zp)
+                for e, z, zp in zip(self.cache.entries, z_list, zp_list)]
+
+    def grid_grad_from_margins_list(self, coefs: Array,
+                                    z_list: Sequence[Array],
+                                    l2s: Array) -> Array:
+        """`[G, d]` gradients from cached `[G, rows]` margins: one
+        rmatvec feature pass for the whole grid."""
+        self._ensure_grid_kits()
+        _M_GRID_PASSES.inc()
+        fold = self._new_fold(grid=True)
+        with span("accumulate"):
+            for e, z in zip(self.cache.blocks(), z_list):
+                self._require_restored(e)
+                with self._dev_span(e.slot):
+                    part = self._grid_kits[e.slot]["grad"](
+                        e.feats, e.labels, e.weights, z, n=e.n_rows)
+                fold.add(e.slot, part)
+            g_raw, su = fold.result()
+        return self._grid_finish_grad(g_raw, su, coefs, l2s)
+
+    def grid_curvature_list(self, z_list: Sequence[Array]) -> List[Array]:
+        """Per-shard `[G, rows]` curvature — row-space, no feature
+        pass."""
+        self._ensure_grid_kits()
+        return [self._grid_kits[e.slot]["curv"](z, e.labels, e.weights)
+                for e, z in zip(self.cache.entries, z_list)]
+
+    def grid_hessian_vector(self, vecs: Array, d2_list: Sequence[Array],
+                            l2s: Array) -> Array:
+        """`[G, d]` H_g @ v_g with per-row curvature: one feature pass
+        serves every grid row's CG iterate."""
+        self._ensure_grid_kits()
+        _M_GRID_PASSES.inc()
+        fold = self._new_fold(grid=True)
+        with span("accumulate"):
+            vs = self._per_device(vecs)
+            for e, d2 in zip(self.cache.blocks(), d2_list):
+                self._require_restored(e)
+                with self._dev_span(e.slot):
+                    part = self._grid_kits[e.slot]["hvp"](
+                        e.feats, e.labels, e.offsets, e.weights, d2,
+                        vs[e.slot], n=e.n_rows)
+                fold.add(e.slot, part)
+            r_raw, su = fold.result()
+        return self._grid_finish_grad(r_raw, su, vecs, l2s)
+
+    def grid_row_margins(self, z_list: Sequence[Array],
+                         row: int) -> List[Array]:
+        """Scalar-shaped per-shard margins for ONE grid row of a grid
+        margin list — feeds `host_scores_from_margins` so `--distmon`
+        per-λ score sketches work unchanged under batching."""
+        return [z[row] for z in z_list]

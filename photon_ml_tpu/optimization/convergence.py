@@ -69,14 +69,18 @@ class ConvergenceRing:
 
 
 def check_solver_finite(solver: str, iteration: int, value, grad_norm,
-                        trace_ctx=None) -> None:
+                        trace_ctx=None, *, lam=None,
+                        grid_row=None) -> None:
     """Divergence watchdog for the host-driven streaming solvers: raise
     :class:`SolverDivergedError` when loss or gradient norm went
     non-finite. ``value``/``grad_norm`` must already be HOST scalars
     (the streamed outer loops hold them for convergence compares, so
     the check adds no device sync). ``trace_ctx`` — the solve's trace
     context, finished as ``diverged`` (tail-kept) and its id attached
-    to the fault so the flight dump is tagged with it."""
+    to the fault so the flight dump is tagged with it. The batched
+    λ-grid solvers pass ``lam``/``grid_row`` so the fault names the ONE
+    grid row that went non-finite (row-isolated divergence — the other
+    rows' masks are untouched when the caller handles the fault)."""
     v, g = float(value), float(grad_norm)
     if math.isfinite(v) and math.isfinite(g):
         return
@@ -85,8 +89,13 @@ def check_solver_finite(solver: str, iteration: int, value, grad_norm,
         trace_id = trace_ctx.trace_id
         trace_ctx.annotate(solver=solver, iteration=int(iteration),
                            value=v, grad_norm=g)
+        if lam is not None:
+            trace_ctx.annotate(reg_weight=float(lam))
+        if grid_row is not None:
+            trace_ctx.annotate(grid_row=int(grid_row))
         trace_ctx.finish("diverged")
-    raise SolverDivergedError(solver, iteration, v, g, trace_id=trace_id)
+    raise SolverDivergedError(solver, iteration, v, g, trace_id=trace_id,
+                              lam=lam, grid_row=grid_row)
 
 
 class SolverDivergedError(RuntimeError):
@@ -103,9 +112,17 @@ class SolverDivergedError(RuntimeError):
     exception — is tagged with a resolvable timeline."""
 
     def __init__(self, solver: str, iteration: int, value, grad_norm,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None, lam=None, grid_row=None):
+        where = ""
+        if grid_row is not None:
+            where = f" [grid row {int(grid_row)}"
+            if lam is not None:
+                where += f", l2={float(lam)!r}"
+            where += "]"
+        elif lam is not None:
+            where = f" [l2={float(lam)!r}]"
         super().__init__(
-            f"{solver} diverged at outer iteration {iteration}: "
+            f"{solver} diverged at outer iteration {iteration}{where}: "
             f"value={value!r}, grad_norm={grad_norm!r} (non-finite). "
             "Typical causes: learning-rate/regularization far off scale, "
             "corrupt feature values, or an overflowing loss; see the "
@@ -116,6 +133,11 @@ class SolverDivergedError(RuntimeError):
         self.value = value
         self.grad_norm = grad_norm
         self.trace_id = trace_id
+        # Batched λ-grid provenance: the ONE row that diverged (other
+        # rows' masks are not poisoned — the caller may drop the row and
+        # continue, or fail the sweep with this evidence attached).
+        self.lam = None if lam is None else float(lam)
+        self.grid_row = None if grid_row is None else int(grid_row)
 
 
 class ConvergenceReason(enum.IntEnum):

@@ -57,6 +57,9 @@ Array = jax.Array
 # never open inside jitted code).
 _H_ITERATION = telemetry.histogram("training.iteration_seconds")
 _M_ITERATIONS = telemetry.counter("training.solver_iterations")
+# Batched λ-grid: grid rows still iterating this outer iteration (gauge,
+# federation merge policy "sum" — the fleet-wide in-flight point count).
+_G_GRID_ACTIVE = telemetry.gauge("training.grid.active_points")
 
 
 class _State(NamedTuple):
@@ -480,6 +483,315 @@ def minimize_lbfgs_glm_streaming(
         coef_history=(None if coef_hist is None
                       else jnp.asarray(coef_hist)),
     )
+
+
+@jax.jit
+def _grid_direction(g, hist, x):
+    """Per-row search directions + line-search dot products: the scalar
+    `_stream_direction` body vmapped over the grid axis."""
+    def one(g_g, hist_g, x_g):
+        direction = compact_direction(g_g, hist_g)
+        dg = jnp.vdot(direction, g_g)
+        direction = jnp.where(dg >= 0, -g_g, direction)
+        return (direction, jnp.vdot(x_g, x_g), jnp.vdot(x_g, direction),
+                jnp.vdot(direction, direction), jnp.vdot(g_g, direction))
+
+    return jax.vmap(one)(g, hist, x)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _grid_candidates(first, pp, f, gp, n, c1):
+    """[G, K] Armijo candidate blocks + thresholds, per grid row."""
+    def one(first_g, pp_g, f_g, gp_g):
+        dtype = pp_g.dtype
+        init_step = jnp.where(first_g,
+                              1.0 / jnp.maximum(jnp.sqrt(pp_g), 1.0),
+                              jnp.ones((), dtype))
+        ks = jnp.arange(n, dtype=dtype)
+        ts = init_step * jnp.power(jnp.asarray(0.5, dtype), ks)
+        return ts, f_g + c1 * ts * gp_g
+
+    return jax.vmap(one)(first, pp, f, gp)
+
+
+@jax.jit
+def _grid_coef_sq(xx, xp, pp, ts):
+    return (xx[:, None] + 2.0 * ts * xp[:, None]
+            + ts * ts * pp[:, None])
+
+
+@jax.jit
+def _grid_axpy_masked(a, t, b):
+    """Per-row a + t*b; rows with t == 0 stay bit-identical (masked, not
+    added — the coefficient-space twin of the grid margin axpy)."""
+    return jnp.where((t != 0.0)[:, None], a + t[:, None] * b, a)
+
+
+@jax.jit
+def _grid_select_rows(mask, a, b):
+    """Per-leaf row select: rows where ``mask`` take ``a``, else ``b``."""
+    def sel(a_leaf, b_leaf):
+        m = mask.reshape(mask.shape + (1,) * (a_leaf.ndim - 1))
+        return jnp.where(m, a_leaf, b_leaf)
+
+    return jax.tree.map(sel, a, b)
+
+
+@jax.jit
+def _grid_update_history(hist, x_new, x, g_new, g, moved):
+    """vmapped cautious history update, applied only to rows that moved
+    (a failed line search must not touch that row's history)."""
+    new = jax.vmap(update_history)(hist, x_new - x, g_new - g)
+    return _grid_select_rows(moved, new, hist)
+
+
+def minimize_lbfgs_glm_grid_streaming(
+    sharded_objective,
+    x0s: Array,
+    l2_weights,
+    *,
+    max_iter: int = 100,
+    tol: float = 1e-7,
+    history_size: int = 10,
+    c1: float = 1e-4,
+    max_line_search: int = 30,
+    track_coefficients: bool = False,
+    trace_ctxs=None,
+    convergence_rings=None,
+    margins_out=None,
+):
+    """Batched λ-grid streaming L-BFGS: ONE set of feature passes per
+    outer iteration advances ALL G grid points (coefficients ``[G, d]``,
+    per-shard margins ``[G, rows]``, λ row ``l2_weights`` of shape
+    ``[G]``). Returns a list of G :class:`OptimizerResult`, row-aligned
+    with the inputs.
+
+    **Masked convergence.** Row state lives on the host as numpy masks:
+    a converged/failed row is frozen by forcing its accepted step to 0
+    and selecting its previous state through ``jnp.where`` row masks —
+    no extra feature passes, no per-row epochs. Each outer iteration
+    still costs exactly 2 feature passes (direction matvec + accepted
+    gradient rmatvec) regardless of G, and the loop ends when every
+    row's mask is done, so the sweep's total pass count is that of the
+    SLOWEST-converging row — not the sum over rows.
+
+    **Bit discipline.** G=1 delegates to
+    :func:`minimize_lbfgs_glm_streaming` outright (XLA's vectorized
+    reduces are not prefix-stable under a leading batch axis, so a
+    ``[1, n]`` vmapped reduction is NOT bitwise the ``[n]`` scalar one)
+    — the batched G=1 solve is the current streamed solver, bit for
+    bit. For G>1 each row follows the scalar iteration's semantics
+    (same candidate schedule, same convergence order and thresholds in
+    the same dtype) with vmap-level reassociation bounds on the values.
+
+    **Per-row observability.** ``trace_ctxs``/``convergence_rings`` are
+    row-aligned lists (either may be None, entries may be None): each
+    active row's TraceContext gets a ``solver_step`` event per outer
+    iteration it participates in, and each ring gets one entry per
+    iteration the row advanced — the same structure a sequential sweep
+    produces. ``training.grid.active_points`` gauges the still-active
+    row count each iteration.
+
+    **Row-isolated divergence.** A non-finite loss/grad-norm in one row
+    raises :class:`SolverDivergedError` carrying that row's λ,
+    ``grid_row`` and trace_id (that row's context is finished as
+    ``diverged``); other rows' masks and state are untouched by the
+    check itself.
+
+    ``margins_out`` receives the final per-shard ``[G, rows]`` margin
+    list; slice one row out with
+    ``ShardedGLMObjective.grid_row_margins``.
+    """
+    import numpy as np
+
+    sobj = sharded_objective
+    x = jnp.asarray(x0s)
+    if x.ndim != 2:
+        raise ValueError(
+            f"x0s must be [G, d] (one coefficient row per grid point), "
+            f"got shape {x.shape}")
+    G, d = x.shape
+    dtype = x.dtype
+    np_dtype = np.dtype(dtype)
+    l2s = jnp.asarray(l2_weights, dtype)
+    if l2s.shape != (G,):
+        raise ValueError(
+            f"l2_weights must be [G]={G} (one λ per grid row), got "
+            f"shape {l2s.shape}")
+    ctxs = list(trace_ctxs) if trace_ctxs is not None else [None] * G
+    rings = (list(convergence_rings) if convergence_rings is not None
+             else [None] * G)
+    if len(ctxs) != G or len(rings) != G:
+        raise ValueError(
+            f"trace_ctxs/convergence_rings must be row-aligned with the "
+            f"grid (G={G}), got {len(ctxs)}/{len(rings)}")
+
+    if G == 1:
+        # Bitwise gate: the 1-row grid IS the scalar streamed solver.
+        holder = [] if margins_out is not None else None
+        res = minimize_lbfgs_glm_streaming(
+            sobj, x[0], l2s[0], max_iter=max_iter, tol=tol,
+            history_size=history_size, c1=c1,
+            max_line_search=max_line_search,
+            track_coefficients=track_coefficients, trace_ctx=ctxs[0],
+            convergence_ring=rings[0], margins_out=holder)
+        if margins_out is not None:
+            margins_out[:] = [z[None] for z in holder]
+        return [res]
+
+    tol_s = np_dtype.type(tol)
+    c1_dev = jnp.asarray(c1, dtype)
+    c1_np = np_dtype.type(c1)
+    l2_h = np.asarray(l2s)
+    n_batched = min(max_line_search + 1, 8)
+
+    z_list, f, g = sobj.grid_margins_value_grad(x, l2s)
+    f_h = np.asarray(f)
+    gnorm = np.asarray(jnp.linalg.norm(g, axis=-1))
+    for gi in range(G):
+        check_solver_finite("streaming-lbfgs-grid", 0, f_h[gi],
+                            gnorm[gi], ctxs[gi], lam=l2_h[gi],
+                            grid_row=gi)
+        if rings[gi] is not None:
+            rings[gi].append(0, f_h[gi], gnorm[gi], None)
+    gnorm0 = gnorm.copy()
+    f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
+    hist = jax.tree.map(lambda a: jnp.stack([a] * G),
+                        _empty_history(d, history_size, dtype))
+
+    value_hist = np.full((G, max_iter + 1), np.nan, np_dtype)
+    gnorm_hist = np.full((G, max_iter + 1), np.nan, np_dtype)
+    value_hist[:, 0], gnorm_hist[:, 0] = f_h, gnorm
+    coef_hist = (np.full((G, max_iter + 1, d), np.nan, np_dtype)
+                 if track_coefficients else None)
+    if coef_hist is not None:
+        coef_hist[:, 0] = np.asarray(x)
+
+    reasons = [ConvergenceReason.GRADIENT_CONVERGED if gnorm0[gi] <= 0.0
+               else ConvergenceReason.NOT_CONVERGED for gi in range(G)]
+    active = np.array(
+        [r == ConvergenceReason.NOT_CONVERGED for r in reasons])
+    its = np.zeros(G, np.int64)
+
+    while active.any():
+        with telemetry.timed_span("solver_step", histogram=_H_ITERATION,
+                                  counter=_M_ITERATIONS):
+            _G_GRID_ACTIVE.set(int(active.sum()))
+            for gi in np.flatnonzero(active):
+                if ctxs[gi] is not None:
+                    ctxs[gi].event("solver_step")
+            dirs, xx, xp, pp, gp = _grid_direction(g, hist, x)
+            zp_list = sobj.grid_margin_direction_list(dirs)
+
+            first_h = np.asarray(hist.count) == 0
+            ts, thresholds = _grid_candidates(
+                jnp.asarray(first_h), pp, jnp.asarray(f_h), gp,
+                n_batched, c1_dev)
+            f_trials = sobj.grid_trial_values(
+                z_list, zp_list, ts, _grid_coef_sq(xx, xp, pp, ts), l2s)
+            ft = np.asarray(f_trials)
+            armijo = np.logical_and(ft <= np.asarray(thresholds),
+                                    np.isfinite(ft))
+            ok = armijo.any(axis=1)
+            idx = np.argmax(armijo, axis=1)
+            ts_h = np.asarray(ts)
+            rows = np.arange(G)
+            t_np = np.where(ok & active, ts_h[rows, idx],
+                            np_dtype.type(0.0))
+            f_new_h = np.where(ok, ft[rows, idx], f_h)
+
+            searching = active & ~ok
+            k = n_batched
+            t_tail = ts_h[:, -1].copy()
+            gp_h = np.asarray(gp)
+            while searching.any() and k < max_line_search + 1:
+                t_tail = t_tail * np_dtype.type(0.5)
+                ts_tail = np.where(searching, t_tail,
+                                   np_dtype.type(0.0))[:, None]
+                tsd = jnp.asarray(ts_tail)
+                f_t = sobj.grid_trial_values(
+                    z_list, zp_list, tsd,
+                    _grid_coef_sq(xx, xp, pp, tsd), l2s)
+                f_t_h = np.asarray(f_t)[:, 0]
+                thr_t = f_h + c1_np * t_tail * gp_h
+                hit = searching & (f_t_h <= thr_t) & np.isfinite(f_t_h)
+                t_np = np.where(hit, t_tail, t_np)
+                f_new_h = np.where(hit, f_t_h, f_new_h)
+                ok |= hit
+                searching &= ~hit
+                k += 1
+
+            its[active] += 1  # failed searches count, like the scalar
+            moved = ok & active
+            failed = active & ~ok
+            for gi in np.flatnonzero(failed):
+                reasons[gi] = ConvergenceReason.OBJECTIVE_NOT_IMPROVING
+                if its[gi] <= max_iter:
+                    value_hist[gi, its[gi]] = f_h[gi]
+                    gnorm_hist[gi, its[gi]] = gnorm[gi]
+                    if coef_hist is not None:
+                        coef_hist[gi, its[gi]] = np.asarray(x[gi])
+                if rings[gi] is not None:
+                    # Failed line search: the row's iterate did not move.
+                    rings[gi].append(int(its[gi]), f_h[gi], gnorm[gi],
+                                     0.0)
+            active &= ok
+
+            if moved.any():
+                t_dev = jnp.asarray(t_np)
+                moved_dev = jnp.asarray(moved)
+                x_new = _grid_axpy_masked(x, t_dev, dirs)
+                z_new = sobj.grid_update_margins(z_list, t_dev, zp_list)
+                g_full = sobj.grid_grad_from_margins_list(
+                    x_new, z_new, l2s)
+                g_new = _grid_select_rows(moved_dev, g_full, g)
+                hist = _grid_update_history(hist, x_new, x, g_new, g,
+                                            moved_dev)
+                gnorm_new = np.asarray(jnp.linalg.norm(g_new, axis=-1))
+                x, z_list, g = x_new, z_new, g_new
+                f_delta = np.abs(f_h - f_new_h)
+                f_h = np.where(moved, f_new_h, f_h)
+                gnorm = np.where(moved, gnorm_new, gnorm)
+
+                for gi in np.flatnonzero(moved):
+                    check_solver_finite(
+                        "streaming-lbfgs-grid", int(its[gi]), f_h[gi],
+                        gnorm[gi], ctxs[gi], lam=l2_h[gi], grid_row=gi)
+                    value_hist[gi, its[gi]] = f_h[gi]
+                    gnorm_hist[gi, its[gi]] = gnorm[gi]
+                    if coef_hist is not None:
+                        coef_hist[gi, its[gi]] = np.asarray(x[gi])
+                    if rings[gi] is not None:
+                        rings[gi].append(int(its[gi]), f_h[gi],
+                                         gnorm[gi], float(t_np[gi]))
+                    if gnorm[gi] <= tol_s * gnorm0[gi]:
+                        reasons[gi] = ConvergenceReason.GRADIENT_CONVERGED
+                    elif f_delta[gi] <= tol_s * f0_scale[gi]:
+                        reasons[gi] = (
+                            ConvergenceReason.FUNCTION_VALUES_CONVERGED)
+                    elif its[gi] >= max_iter:
+                        reasons[gi] = ConvergenceReason.MAX_ITERATIONS
+                    if reasons[gi] != ConvergenceReason.NOT_CONVERGED:
+                        active[gi] = False
+    _G_GRID_ACTIVE.set(0)
+
+    if margins_out is not None:
+        margins_out[:] = z_list
+    x_np = np.asarray(x)
+    return [
+        OptimizerResult(
+            x=jnp.asarray(x_np[gi]),
+            value=jnp.asarray(f_h[gi]),
+            grad_norm=jnp.asarray(gnorm[gi]),
+            iterations=jnp.asarray(int(its[gi]), jnp.int32),
+            reason=jnp.asarray(int(reasons[gi]), jnp.int32),
+            value_history=jnp.asarray(value_hist[gi]),
+            grad_norm_history=jnp.asarray(gnorm_hist[gi]),
+            coef_history=(None if coef_hist is None
+                          else jnp.asarray(coef_hist[gi])),
+        )
+        for gi in range(G)
+    ]
 
 
 def minimize_lbfgs_glm(

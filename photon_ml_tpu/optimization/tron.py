@@ -35,6 +35,9 @@ Array = jax.Array
 # (optimization/glm_lbfgs.py) — one histogram, one schema.
 _H_ITERATION = telemetry.histogram("training.iteration_seconds")
 _M_ITERATIONS = telemetry.counter("training.solver_iterations")
+# Batched λ-grid: grid rows still iterating (same gauge object as the
+# streaming L-BFGS — the registry is get-or-create).
+_G_GRID_ACTIVE = telemetry.gauge("training.grid.active_points")
 
 _ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
 _SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
@@ -508,6 +511,238 @@ def minimize_tron_streaming(
         coef_history=(None if coef_hist is None
                       else jnp.asarray(coef_hist)),
     )
+
+
+@jax.jit
+def _grid_cg_step(s, r, d_vec, rtr, hd, delta, stop_norm):
+    """Per-row Steihaug-Toint CG step: `_stream_cg_step` vmapped over
+    the grid axis (every array gains a leading [G])."""
+    return jax.vmap(_stream_cg_step)(s, r, d_vec, rtr, hd, delta,
+                                     stop_norm)
+
+
+@jax.jit
+def _grid_tr_update(f, f_new, g, s, r, delta, first):
+    """Per-row LIBLINEAR trust-region update: `_stream_tr_update`
+    vmapped over the grid axis (``first`` broadcast — all rows share
+    the before-first-step clamp)."""
+    return jax.vmap(_stream_tr_update,
+                    in_axes=(0, 0, 0, 0, 0, 0, None))(
+        f, f_new, g, s, r, delta, first)
+
+
+def minimize_tron_grid_streaming(
+    sharded_objective,
+    x0s: Array,
+    l2_weights,
+    *,
+    max_iter: int = 15,
+    tol: float = 1e-5,
+    max_cg: int = 20,
+    max_improvement_failures: int = 5,
+    track_coefficients: bool = False,
+    trace_ctxs=None,
+    convergence_rings=None,
+    margins_out=None,
+):
+    """Batched λ-grid streaming TRON: one curvature pass, one shared CG
+    (each Hvp feature pass serves EVERY grid row's iterate), and one
+    trial evaluation pass advance all G trust-region solves per outer
+    iteration. Coefficients ``[G, d]``, margins/curvature ``[G, rows]``
+    per shard, λ row ``[G]``. Returns a list of G
+    :class:`OptimizerResult`, row-aligned with the inputs.
+
+    **Masked convergence.** Per-row CG done-masks freeze a row's
+    (s, r, d, rtr) once it hits its own Steihaug-Toint stop; the inner
+    loop runs until every ACTIVE row is done or ``max_cg`` — so a
+    sweep's Hvp pass count is the slowest row's CG depth, not the sum.
+    Outer accept/reject, improvement-failure budgets and convergence
+    reasons are per row (host numpy masks); finished rows take step 0
+    and keep their state bit-identical through `jnp.where` row selects.
+
+    **Bit discipline / observability / divergence** follow
+    :func:`~photon_ml_tpu.optimization.glm_lbfgs.minimize_lbfgs_glm_grid_streaming`:
+    G=1 delegates to :func:`minimize_tron_streaming` (bitwise gate);
+    ``trace_ctxs``/``convergence_rings`` are row-aligned; only ACCEPTED
+    states are watchdog-checked, and a non-finite accepted row raises
+    :class:`SolverDivergedError` with that row's λ and ``grid_row``.
+    """
+    import numpy as np
+
+    from photon_ml_tpu.optimization.glm_lbfgs import _grid_select_rows
+
+    sobj = sharded_objective
+    x = jnp.asarray(x0s)
+    if x.ndim != 2:
+        raise ValueError(
+            f"x0s must be [G, d] (one coefficient row per grid point), "
+            f"got shape {x.shape}")
+    G, d = x.shape
+    dtype = x.dtype
+    np_dtype = np.dtype(dtype)
+    l2s = jnp.asarray(l2_weights, dtype)
+    if l2s.shape != (G,):
+        raise ValueError(
+            f"l2_weights must be [G]={G} (one λ per grid row), got "
+            f"shape {l2s.shape}")
+    ctxs = list(trace_ctxs) if trace_ctxs is not None else [None] * G
+    rings = (list(convergence_rings) if convergence_rings is not None
+             else [None] * G)
+    if len(ctxs) != G or len(rings) != G:
+        raise ValueError(
+            f"trace_ctxs/convergence_rings must be row-aligned with the "
+            f"grid (G={G}), got {len(ctxs)}/{len(rings)}")
+
+    if G == 1:
+        # Bitwise gate: the 1-row grid IS the scalar streamed solver.
+        holder = [] if margins_out is not None else None
+        res = minimize_tron_streaming(
+            sobj, x[0], l2s[0], max_iter=max_iter, tol=tol,
+            max_cg=max_cg,
+            max_improvement_failures=max_improvement_failures,
+            track_coefficients=track_coefficients, trace_ctx=ctxs[0],
+            convergence_ring=rings[0], margins_out=holder)
+        if margins_out is not None:
+            margins_out[:] = [z[None] for z in holder]
+        return [res]
+
+    tol_s = np_dtype.type(tol)
+    l2_h = np.asarray(l2s)
+    z_list, f, g = sobj.grid_margins_value_grad(x, l2s)
+    f_h = np.asarray(f)
+    gnorm = np.asarray(jnp.linalg.norm(g, axis=-1))
+    for gi in range(G):
+        check_solver_finite("streaming-tron-grid", 0, f_h[gi],
+                            gnorm[gi], ctxs[gi], lam=l2_h[gi],
+                            grid_row=gi)
+        if rings[gi] is not None:
+            rings[gi].append(0, f_h[gi], gnorm[gi], None)
+    gnorm0 = gnorm.copy()
+    f0_scale = np.maximum(np.abs(f_h), np_dtype.type(1e-30))
+    delta = jnp.asarray(gnorm0)
+
+    value_hist = np.full((G, max_iter + 1), np.nan, np_dtype)
+    gnorm_hist = np.full((G, max_iter + 1), np.nan, np_dtype)
+    value_hist[:, 0], gnorm_hist[:, 0] = f_h, gnorm
+    coef_hist = (np.full((G, max_iter + 1, d), np.nan, np_dtype)
+                 if track_coefficients else None)
+    if coef_hist is not None:
+        coef_hist[:, 0] = np.asarray(x)
+
+    reasons = [ConvergenceReason.GRADIENT_CONVERGED if gnorm0[gi] <= 0.0
+               else ConvergenceReason.NOT_CONVERGED for gi in range(G)]
+    active = np.array(
+        [r == ConvergenceReason.NOT_CONVERGED for r in reasons])
+    its = np.zeros(G, np.int64)
+    fails = np.zeros(G, np.int64)
+    first = True
+
+    while active.any():
+        with telemetry.timed_span("solver_step", histogram=_H_ITERATION,
+                                  counter=_M_ITERATIONS):
+            _G_GRID_ACTIVE.set(int(active.sum()))
+            for gi in np.flatnonzero(active):
+                if ctxs[gi] is not None:
+                    ctxs[gi].event("solver_step")
+            d2_list = sobj.grid_curvature_list(z_list)
+
+            # -- per-row truncated CG: one shared Hvp feature pass per
+            # step; rows past their own stop are frozen by row masks,
+            # and the loop runs to the slowest ACTIVE row's depth.
+            s = jnp.zeros_like(g)
+            r = -g
+            d_vec = -g
+            rtr = jnp.sum(r * r, axis=-1)
+            stop_norm = _CG_XI * jnp.linalg.norm(g, axis=-1)
+            cg_done = (np.asarray(
+                jnp.linalg.norm(r, axis=-1) <= stop_norm) | ~active)
+            k = 0
+            while not cg_done.all() and k < max_cg:
+                hd = sobj.grid_hessian_vector(d_vec, d2_list, l2s)
+                s2, r2, d2v, rtr2, done_dev = _grid_cg_step(
+                    s, r, d_vec, rtr, hd, delta, stop_norm)
+                run = jnp.asarray(~cg_done)
+                s = _grid_select_rows(run, s2, s)
+                r = _grid_select_rows(run, r2, r)
+                d_vec = _grid_select_rows(run, d2v, d_vec)
+                rtr = jnp.where(run, rtr2, rtr)
+                cg_done |= (~cg_done) & np.asarray(done_dev)
+                k += 1
+
+            active_dev = jnp.asarray(active)
+            x_try = _grid_select_rows(active_dev, x + s, x)
+            z_try, f_new, g_new = sobj.grid_margins_value_grad(
+                x_try, l2s)
+            delta_new, accept_dev = _grid_tr_update(
+                jnp.asarray(f_h), f_new, g, s, r, delta,
+                jnp.asarray(first))
+            first = False
+            delta = jnp.where(active_dev, delta_new, delta)
+            accept = np.asarray(accept_dev) & active
+
+            if accept.any():
+                acc_dev = jnp.asarray(accept)
+                x = _grid_select_rows(acc_dev, x_try, x)
+                g = _grid_select_rows(acc_dev, g_new, g)
+                z_list = [jnp.where(acc_dev[:, None], zt, z)
+                          for zt, z in zip(z_try, z_list)]
+                snorm = np.asarray(jnp.linalg.norm(s, axis=-1))
+                f_new_h = np.asarray(f_new)
+                gnorm_new = np.asarray(jnp.linalg.norm(g, axis=-1))
+                f_delta = np.abs(f_h - f_new_h)
+                f_h = np.where(accept, f_new_h, f_h)
+                gnorm = np.where(accept, gnorm_new, gnorm)
+                its[accept] += 1
+                fails[accept] = 0
+                for gi in np.flatnonzero(accept):
+                    # Watchdog on ACCEPTED rows only — a rejected
+                    # non-finite trial is normal trust-region behavior.
+                    check_solver_finite(
+                        "streaming-tron-grid", int(its[gi]), f_h[gi],
+                        gnorm[gi], ctxs[gi], lam=l2_h[gi], grid_row=gi)
+                    value_hist[gi, its[gi]] = f_h[gi]
+                    gnorm_hist[gi, its[gi]] = gnorm[gi]
+                    if coef_hist is not None:
+                        coef_hist[gi, its[gi]] = np.asarray(x[gi])
+                    if rings[gi] is not None:
+                        rings[gi].append(int(its[gi]), f_h[gi],
+                                         gnorm[gi], float(snorm[gi]))
+                    if gnorm[gi] <= tol_s * gnorm0[gi]:
+                        reasons[gi] = ConvergenceReason.GRADIENT_CONVERGED
+                    elif f_delta[gi] <= tol_s * f0_scale[gi]:
+                        reasons[gi] = (
+                            ConvergenceReason.FUNCTION_VALUES_CONVERGED)
+                    elif its[gi] >= max_iter:
+                        reasons[gi] = ConvergenceReason.MAX_ITERATIONS
+                    if reasons[gi] != ConvergenceReason.NOT_CONVERGED:
+                        active[gi] = False
+
+            rejected = active & ~accept
+            fails[rejected] += 1
+            for gi in np.flatnonzero(rejected):
+                if fails[gi] > max_improvement_failures:
+                    reasons[gi] = (
+                        ConvergenceReason.OBJECTIVE_NOT_IMPROVING)
+                    active[gi] = False
+    _G_GRID_ACTIVE.set(0)
+
+    if margins_out is not None:
+        margins_out[:] = z_list
+    x_np = np.asarray(x)
+    return [
+        OptimizerResult(
+            x=jnp.asarray(x_np[gi]),
+            value=jnp.asarray(f_h[gi]),
+            grad_norm=jnp.asarray(gnorm[gi]),
+            iterations=jnp.asarray(int(its[gi]), jnp.int32),
+            reason=jnp.asarray(int(reasons[gi]), jnp.int32),
+            value_history=jnp.asarray(value_hist[gi]),
+            grad_norm_history=jnp.asarray(gnorm_hist[gi]),
+            coef_history=(None if coef_hist is None
+                          else jnp.asarray(coef_hist[gi])),
+        )
+        for gi in range(G)
+    ]
 
 
 def minimize_tron(

@@ -106,6 +106,9 @@ GAUGE_MERGE_POLICIES: Dict[str, str] = {
     ".score_drift_psi": "max",
     ".score_drift_ks": "max",
     ".score_dist_rows": "sum",
+    # Batched λ-grid: in-flight grid points sum across processes (the
+    # fleet-wide count of λ points still iterating).
+    "training.grid.active_points": "sum",
 }
 
 _VALID_POLICIES = ("sum", "max", "last")

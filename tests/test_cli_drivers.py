@@ -743,6 +743,75 @@ def test_spill_flags_require_hbm_budget(tmp_path, rng):
                     "--spill-source", "redecode"])
 
 
+_GRID_STREAM_BASE = [
+    "--task-type", "LOGISTIC_REGRESSION",
+    "--fixed-effect-data-configurations", "fixed:global",
+    "--fixed-effect-optimization-configurations",
+    "fixed:25,1e-7,0.5,1.0,LBFGS,L2|25,1e-7,5.0,1.0,LBFGS,L2"
+    "|25,1e-7,50.0,1.0,LBFGS,L2",
+    "--updating-sequence", "fixed",
+]
+
+
+def test_grid_batched_sweep_selects_same_model(tmp_path, rng):
+    """--grid-batched: 'auto' batches a 3-point λ-grid into one streamed
+    sweep that selects the SAME λ as the sequential sweep with
+    per-coefficient agreement on the saved model; 'on' with G=1 writes
+    model bytes IDENTICAL to the sequential solve (the bitwise gate,
+    end to end through the CLI)."""
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300)
+    base = ["--train-input-dirs", str(train)] + _GRID_STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64", "--hbm-budget", "8K"]
+    seq = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "seq"),
+                "--grid-batched", "off"])
+    bat = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "bat")])
+    assert seq["stream_train"]["grid_batched"] is False
+    assert bat["stream_train"]["grid_batched"] is True
+    assert seq["stream_train"]["grid_points"] == \
+        bat["stream_train"]["grid_points"] == 3
+    assert seq["bestConfigs"] == bat["bestConfigs"]  # selection parity
+    ref = {r["name"]: r["value"]
+           for r in _coeff_records(tmp_path / "seq")[0]["means"]}
+    got = {r["name"]: r["value"]
+           for r in _coeff_records(tmp_path / "bat")[0]["means"]}
+    assert set(ref) == set(got)
+    np.testing.assert_allclose([got[k] for k in sorted(ref)],
+                               [ref[k] for k in sorted(ref)],
+                               rtol=2e-3, atol=1e-4)
+    # the sweep's grid kernels stayed within their compile budgets
+    assert any(k.startswith("sharded:grid_") and v > 0
+               for k, v in bat["stream_train"]["trace_counts"].items())
+    # G=1 forced batched: bitwise model identity with sequential
+    g1 = ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64", "--hbm-budget", "8K"]
+    game_training_driver.run(
+        g1 + ["--output-dir", str(tmp_path / "g1seq"),
+              "--grid-batched", "off"])
+    on = game_training_driver.run(
+        g1 + ["--output-dir", str(tmp_path / "g1on"),
+              "--grid-batched", "on"])
+    assert on["stream_train"]["grid_batched"] is True
+    assert _coeff_records(tmp_path / "g1seq") == \
+        _coeff_records(tmp_path / "g1on")
+
+
+def test_grid_batched_flag_validation(tmp_path, rng):
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=60)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE
+    with pytest.raises(ValueError, match="--grid-batched applies"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "a"),
+                    "--grid-batched", "on"])
+    with pytest.raises(ValueError, match="--grid-batched on requires"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "b"),
+                    "--stream-train", "--grid-batched", "on"])
+
+
 def _write_mf_avro(path, rng, n=240, n_users=9, d=6, k_true=2):
     """Linear labels with per-entity rank-k_true coefficient structure —
     the streamed-MF coordinate's training shape (userId in
@@ -1185,13 +1254,15 @@ def test_stream_train_snake_schema_and_trace(tmp_path, rng):
     info = summary["stream_train"]
     assert set(info) == {"mode", "batch_rows", "hbm_budget_bytes",
                          "mesh_devices", "spill_dtype", "spill_source",
-                         "feeder", "cache",
+                         "feeder", "cache", "grid_batched", "grid_points",
                          "trace_budgets", "trace_counts"}
     assert info["batch_rows"] == 32
     assert info["mode"] == "spill"
     assert info["mesh_devices"] is None
     assert info["spill_dtype"] == "f32"
     assert info["spill_source"] == "buffer"
+    assert info["grid_batched"] is False  # single-λ grid stays sequential
+    assert info["grid_points"] == 1
     assert "streamTrain" not in summary  # deprecated alias removed
 
     tele = summary["telemetry"]

@@ -1,0 +1,445 @@
+"""Batched λ-grid streamed solves (PR 16): the one-pass sweep contract.
+
+- G=1 batched DELEGATES to the scalar streamed solver — model bytes
+  identical (the bitwise gate holds by construction).
+- G>1 batched L-BFGS reproduces the sequential per-λ sweep's iteration
+  structure (same counts/reasons) with per-coefficient agreement to
+  accumulation tolerance, and both sweeps select the SAME model.
+- Feature passes per sweep are independent of G (the whole point: one
+  streamed epoch advances every grid point).
+- Per-λ observability survives batching: convergence rings keep the
+  sequential ring structure, a diverging row raises
+  SolverDivergedError carrying ITS λ / grid row / trace id, and rows
+  other than the poisoned one keep finite-only rings.
+- Compile counts stay inside the grid kernel budgets and are flat
+  across λ values (λ is a traced argument, G is the only shape knob).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.algorithm.coordinate_descent import (
+    CoordinateDescentResult,
+)
+from photon_ml_tpu.algorithm.coordinates import (
+    StreamingFixedEffectCoordinate,
+    grid_batchable,
+    solve_fixed_effect_grid,
+)
+from photon_ml_tpu.data.shard_cache import DeviceShardCache
+from photon_ml_tpu.estimators.game_estimator import select_best_result
+from photon_ml_tpu.ops.glm_objective import GLMObjective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.sharded_objective import ShardedGLMObjective
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.convergence import (
+    ConvergenceReason,
+    ConvergenceRing,
+    SolverDivergedError,
+)
+from photon_ml_tpu.optimization.glm_lbfgs import (
+    minimize_lbfgs_glm_grid_streaming,
+    minimize_lbfgs_glm_streaming,
+)
+from photon_ml_tpu.optimization.tron import (
+    minimize_tron_grid_streaming,
+    minimize_tron_streaming,
+)
+from photon_ml_tpu.types import TaskType
+
+from tests.test_shard_cache import FakeStream
+
+
+@pytest.fixture
+def problem(rng):
+    n, d = 403, 23
+    X = sp.random(n, d, density=0.15, random_state=7, format="csr")
+    X.data[:] = rng.normal(0, 1, X.nnz)
+    y = (rng.random(n) < 0.5).astype(float)
+    off = rng.normal(0, 0.1, n)
+    w = rng.gamma(1.0, 1.0, n)
+    return X, y, off, w
+
+
+def _sharded(X, y, off, w, batch_rows=96, budget=None):
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, batch_rows, off, w), "g",
+        hbm_budget_bytes=budget)
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    return ShardedGLMObjective(obj, cache)
+
+
+def _bits(x):
+    return np.asarray(x).tobytes()
+
+
+def _x0s(G, d):
+    return jnp.zeros((G, d), jnp.float32)
+
+
+# -- bitwise gate -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("grid_fn,scalar_fn", [
+    (minimize_lbfgs_glm_grid_streaming, minimize_lbfgs_glm_streaming),
+    (minimize_tron_grid_streaming, minimize_tron_streaming),
+])
+def test_g1_batched_bitwise_identical(problem, grid_fn, scalar_fn):
+    """G=1 batched == scalar streamed solver, bit for bit (delegation:
+    there is no '1-wide vmap' variant to drift — XLA's batched reduces
+    are not prefix-stable, so the gate holds by construction)."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    ref = scalar_fn(sobj, jnp.zeros(d, jnp.float32),
+                    np.float32(0.7), max_iter=12)
+    holder = []
+    [res] = grid_fn(sobj, _x0s(1, d), np.asarray([0.7], np.float32),
+                    max_iter=12, margins_out=holder)
+    assert _bits(res.x) == _bits(ref.x)
+    assert _bits(res.value) == _bits(ref.value)
+    assert res.iterations == ref.iterations
+    assert res.reason == ref.reason
+    # margins come back grid-shaped even on the delegated path
+    assert all(z.ndim == 2 and z.shape[0] == 1 for z in holder)
+
+
+# -- G>1 parity + selection -------------------------------------------------
+
+
+def test_grid_lbfgs_matches_sequential_and_selects_same(problem):
+    """Batched L-BFGS over G=3 λ rows: per-row iteration counts and
+    convergence reasons equal the sequential sweep's, coefficients agree
+    to accumulation tolerance, and the lowest-objective row is the same
+    model either way (selection parity, the G>1 acceptance bound)."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    l2s = np.asarray([0.3, 3.0, 30.0], np.float32)
+    seq = [minimize_lbfgs_glm_streaming(
+        sobj, jnp.zeros(d, jnp.float32), l2, max_iter=25) for l2 in l2s]
+    grid = minimize_lbfgs_glm_grid_streaming(
+        sobj, _x0s(3, d), l2s, max_iter=25)
+    for gi, (s, g) in enumerate(zip(seq, grid)):
+        assert g.iterations == s.iterations, gi
+        assert g.reason == s.reason, gi
+        np.testing.assert_allclose(np.asarray(g.x), np.asarray(s.x),
+                                   rtol=2e-3, atol=1e-4)
+    assert int(np.argmin([float(r.value) for r in grid])) == \
+        int(np.argmin([float(r.value) for r in seq]))
+
+
+def test_grid_tron_parity_bounds(problem):
+    """Batched TRON G>1: vmapped reduction order may flip an accept
+    decision at the trust-region boundary, so (unlike L-BFGS) iteration
+    counts are NOT asserted — the contract is per-coefficient agreement
+    within documented bounds plus identical selection."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    l2s = np.asarray([0.3, 3.0], np.float32)
+    seq = [minimize_tron_streaming(
+        sobj, jnp.zeros(d, jnp.float32), l2, max_iter=10) for l2 in l2s]
+    grid = minimize_tron_grid_streaming(sobj, _x0s(2, d), l2s, max_iter=10)
+    for gi, (s, g) in enumerate(zip(seq, grid)):
+        np.testing.assert_allclose(np.asarray(g.x), np.asarray(s.x),
+                                   rtol=1e-3, atol=1e-3, err_msg=str(gi))
+    assert int(np.argmin([float(r.value) for r in grid])) == \
+        int(np.argmin([float(r.value) for r in seq]))
+
+
+# -- masked convergence edge cases ------------------------------------------
+
+
+def test_all_rows_identical_lambda_converge_together(problem):
+    """Degenerate masking: every row the same λ ⇒ every row converges at
+    the same outer iteration with the same reason and identical
+    coefficient rows (the mask never splits the batch)."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    res = minimize_lbfgs_glm_grid_streaming(
+        sobj, _x0s(3, d), np.asarray([2.0, 2.0, 2.0], np.float32),
+        max_iter=20)
+    assert len({int(r.iterations) for r in res}) == 1
+    assert len({int(r.reason) for r in res}) == 1
+    assert _bits(res[0].x) == _bits(res[1].x) == _bits(res[2].x)
+
+
+def test_max_iters_row_rides_along_frozen(problem):
+    """A slow row hitting max_iter must not perturb rows that converged
+    earlier: the tiny-λ row reports MAX_ITERATIONS while the heavy-λ
+    rows converge, and each converged row equals its own sequential
+    solve bit-for-... (to accumulation tolerance)."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    l2s = np.asarray([1e-4, 50.0], np.float32)
+    res = minimize_lbfgs_glm_grid_streaming(
+        sobj, _x0s(2, d), l2s, max_iter=4, tol=1e-9)
+    assert res[0].reason == int(ConvergenceReason.MAX_ITERATIONS)
+    assert res[0].iterations == 4
+    # frozen ride-along: the converged/stopped rows match sequential
+    for gi, l2 in enumerate(l2s):
+        s = minimize_lbfgs_glm_streaming(
+            sobj, jnp.zeros(d, jnp.float32), l2, max_iter=4, tol=1e-9)
+        assert res[gi].iterations == s.iterations, gi
+        np.testing.assert_allclose(np.asarray(res[gi].x), np.asarray(s.x),
+                                   rtol=2e-3, atol=1e-4)
+
+
+# -- per-λ observability under batching -------------------------------------
+
+
+def test_ring_structure_batched_equals_sequential(problem):
+    """Satellite regression: each λ's ConvergenceRing under batching has
+    the SAME structure as its sequential solve's ring — same entry
+    count, same iteration column, matching loss/grad-norm values."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    l2s = np.asarray([0.3, 3.0, 30.0], np.float32)
+    seq_rings = [ConvergenceRing() for _ in l2s]
+    for ring, l2 in zip(seq_rings, l2s):
+        minimize_lbfgs_glm_streaming(
+            sobj, jnp.zeros(d, jnp.float32), l2, max_iter=15,
+            convergence_ring=ring)
+    grid_rings = [ConvergenceRing() for _ in l2s]
+    minimize_lbfgs_glm_grid_streaming(
+        sobj, _x0s(3, d), l2s, max_iter=15, convergence_rings=grid_rings)
+    for gi, (sr, gr) in enumerate(zip(seq_rings, grid_rings)):
+        s, g = sr.snapshot()["tail"], gr.snapshot()["tail"]
+        assert len(g) == len(s), gi
+        assert [e["iteration"] for e in g] == \
+            [e["iteration"] for e in s], gi
+        np.testing.assert_allclose([e["value"] for e in g],
+                                   [e["value"] for e in s],
+                                   rtol=1e-3, err_msg=str(gi))
+        # grad norms shrink to ~tol: near-zero tails are relatively
+        # noisy between the vmapped and the scalar accumulation — the
+        # regression target is the ring STRUCTURE plus a loose value
+        # envelope, not bitwise trajectories.
+        np.testing.assert_allclose([e["grad_norm"] for e in g],
+                                   [e["grad_norm"] for e in s],
+                                   rtol=0.5, atol=1e-2, err_msg=str(gi))
+
+
+def test_poisoned_lambda_diverges_row_isolated(problem):
+    """A NaN λ row must fail as ITSELF: SolverDivergedError carries the
+    row's λ, grid row index, and ITS per-λ trace id (not the sweep's or
+    a neighbour's), and the healthy rows' rings hold only finite
+    entries up to the raise."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    l2s = np.asarray([0.5, np.nan, 2.0], np.float32)
+    ctxs = [telemetry.mint("solve") for _ in l2s]
+    rings = [ConvergenceRing() for _ in l2s]
+    with pytest.raises(SolverDivergedError) as exc:
+        minimize_lbfgs_glm_grid_streaming(
+            sobj, _x0s(3, d), l2s, max_iter=10,
+            trace_ctxs=ctxs, convergence_rings=rings)
+    err = exc.value
+    assert err.grid_row == 1
+    assert np.isnan(err.lam)
+    assert err.trace_id == ctxs[1].trace_id
+    assert "grid row 1" in str(err)
+    for gi in (0, 2):
+        for entry in rings[gi].snapshot()["tail"]:
+            assert np.isfinite(entry["value"]), gi
+            assert np.isfinite(entry["grad_norm"]), gi
+
+
+def test_grid_telemetry_pass_counter_and_active_gauge(problem):
+    """training.grid.feature_passes counts every batched pass;
+    training.grid.active_points ends a sweep at 0 (all rows retired)."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        counter = telemetry.counter("training.grid.feature_passes")
+        gauge = telemetry.gauge("training.grid.active_points")
+        minimize_lbfgs_glm_grid_streaming(
+            sobj, _x0s(2, X.shape[1]), np.asarray([0.5, 5.0], np.float32),
+            max_iter=6)
+        assert counter.value > 0
+        assert gauge.calls > 0  # was live during the sweep ...
+        assert gauge.value == 0  # ... and retired every row at the end
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_grid_gauge_federation_policy():
+    """Fleet merge: active grid points SUM across processes (each
+    process sweeps its own grid slice)."""
+    from photon_ml_tpu.telemetry.federation import gauge_merge_policy
+
+    assert gauge_merge_policy("training.grid.active_points") == "sum"
+
+
+# -- feature-pass economics -------------------------------------------------
+
+
+def test_feature_passes_independent_of_grid_width(problem):
+    """THE perf claim: a sweep's streamed epochs depend on the iteration
+    count, not on G — G=2 and G=4 grids with the same schedule replay
+    the cache the same number of times (sequential would pay ~G×)."""
+    X, y, off, w = problem
+    d = X.shape[1]
+    epochs = {}
+    for G in (2, 4):
+        sobj = _sharded(X, y, off, w, budget=40_000)
+        base = sobj.cache.stats()["epochs"]
+        l2s = np.geomspace(0.5, 50.0, G).astype(np.float32)
+        minimize_lbfgs_glm_grid_streaming(
+            sobj, _x0s(G, d), l2s, max_iter=8, tol=0.0)
+        epochs[G] = sobj.cache.stats()["epochs"] - base
+    assert epochs[2] == epochs[4] > 0
+
+
+def test_grid_compile_counts_bounded_and_flat_across_lambdas(problem):
+    """TracingGuard budgets hold for the grid kernels, and a second
+    sweep with DIFFERENT λ values (same G) compiles nothing new — λ is
+    a traced argument, exactly like the scalar streamed solvers."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    d = X.shape[1]
+    minimize_lbfgs_glm_grid_streaming(
+        sobj, _x0s(2, d), np.asarray([0.5, 5.0], np.float32), max_iter=6)
+    sobj.assert_trace_budget()
+    counts = dict(sobj.guard.counts())
+    assert any(k.startswith("sharded:grid_") and v > 0
+               for k, v in counts.items())
+    minimize_lbfgs_glm_grid_streaming(
+        sobj, _x0s(2, d), np.asarray([0.01, 900.0], np.float32),
+        max_iter=6)
+    assert sobj.guard.counts() == counts
+    sobj.assert_trace_budget()
+
+
+def test_sequential_sweep_never_compiles_grid_kernels(problem):
+    """Grid kits build lazily: a sharded objective used only by scalar
+    streamed solves must carry zero grid-kernel traces (and no grid
+    entries in its declared budgets)."""
+    X, y, off, w = problem
+    sobj = _sharded(X, y, off, w)
+    minimize_lbfgs_glm_streaming(
+        sobj, jnp.zeros(X.shape[1], jnp.float32), np.float32(1.0),
+        max_iter=5)
+    assert not any(k.startswith("sharded:grid_")
+                   for k in sobj.guard.counts())
+    assert not any(k.startswith("sharded:grid_")
+                   for k in sobj.trace_budgets())
+
+
+# -- coordinate-level entry point -------------------------------------------
+
+
+def _cfg(l2, optimizer="LBFGS", max_iterations=12):
+    return GLMOptimizationConfiguration.parse(
+        f"{max_iterations},1e-7,{l2},1.0,{optimizer},L2")
+
+
+def test_solve_fixed_effect_grid_matches_sequential_coordinate(problem):
+    """coordinate-level sweep: solve_fixed_effect_grid returns the same
+    (model, result) rows G sequential coordinate.solve calls produce
+    (selection-grade agreement), slicing per-row margins out of the
+    batched [G, rows] holder."""
+    X, y, off, w = problem
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, 96, off, w), "g", hbm_budget_bytes=None)
+    configs = [_cfg(0.5), _cfg(5.0)]
+    holder = []
+    coord = StreamingFixedEffectCoordinate(
+        name="fixed", cache=cache, feature_shard_id="g",
+        task_type=TaskType.LOGISTIC_REGRESSION, config=configs[0])
+    pairs = solve_fixed_effect_grid(coord, configs, margins_out=holder)
+    assert len(pairs) == 2
+    shared = coord.sharded_objective
+    for gi, cfg in enumerate(configs):
+        seq_coord = StreamingFixedEffectCoordinate(
+            name="fixed", cache=cache, feature_shard_id="g",
+            task_type=TaskType.LOGISTIC_REGRESSION, config=cfg,
+            sharded_objective=shared)
+        seq_holder = []
+        _, seq_res = seq_coord.solve(None, margins_out=seq_holder)
+        model, res = pairs[gi]
+        np.testing.assert_allclose(
+            np.asarray(model.glm.coefficients.means),
+            np.asarray(seq_res.x), rtol=2e-3, atol=1e-4)
+        assert res.iterations == seq_res.iterations
+        row = shared.grid_row_margins(holder, gi)
+        for zr, zs in zip(row, seq_holder):
+            np.testing.assert_allclose(np.asarray(zr), np.asarray(zs),
+                                       rtol=2e-3, atol=1e-3)
+
+
+def test_grid_batchable_rejections():
+    ok, why = grid_batchable([])
+    assert not ok and "empty" in why
+    assert grid_batchable([_cfg(0.5), _cfg(5.0)])[0]
+    # heterogeneous optimizer
+    ok, why = grid_batchable([_cfg(0.5), _cfg(5.0, optimizer="TRON")])
+    assert not ok and "optimizer" in why
+    # heterogeneous schedule
+    ok, why = grid_batchable([_cfg(0.5), _cfg(5.0, max_iterations=30)])
+    assert not ok and "max_iterations" in why
+    # L1 grid points
+    l1_cfg = GLMOptimizationConfiguration.parse(
+        "12,1e-7,0.5,1.0,LBFGS,L1")
+    ok, why = grid_batchable([l1_cfg])
+    assert not ok and "L1" in why
+
+
+# -- deterministic tie-break ------------------------------------------------
+
+
+def _fake_result(objective):
+    return CoordinateDescentResult(
+        model=object(), objective_history=[objective],
+        validation_history=[], best_model=None, best_metric=None,
+        trackers={}, timings={})
+
+
+def test_select_best_result_exact_tie_goes_to_smallest_lambda():
+    """Documented contract: an EXACT objective tie selects the smallest
+    λ, whatever order the sweep enumerated the grid in — batched and
+    sequential sweeps can never disagree on the selected model."""
+    lo = ({"fixed": _cfg(0.5)}, _fake_result(1.25))
+    hi = ({"fixed": _cfg(5.0)}, _fake_result(1.25))
+    for order in ([lo, hi], [hi, lo]):
+        configs, _ = select_best_result(order, [])
+        assert configs["fixed"].regularization_weight == 0.5
+    # non-tie still picks the lower objective regardless of λ
+    better_hi = ({"fixed": _cfg(5.0)}, _fake_result(1.0))
+    configs, _ = select_best_result([lo, better_hi], [])
+    assert configs["fixed"].regularization_weight == 5.0
+
+
+def test_select_best_result_validation_tie_break():
+    class Auc:
+        name = "AUC"
+
+        @staticmethod
+        def better_than(a, b):
+            return a > b
+
+    def with_val(cfg_l2, metric):
+        res = _fake_result(1.0)
+        res.validation_history.append({"AUC": metric})
+        return ({"fixed": _cfg(cfg_l2)}, res)
+
+    tie_small, tie_big = with_val(0.5, 0.8), with_val(5.0, 0.8)
+    for order in ([tie_small, tie_big], [tie_big, tie_small]):
+        configs, _ = select_best_result(order, [Auc()])
+        assert configs["fixed"].regularization_weight == 0.5
+    # a strictly better metric still wins over a smaller λ
+    configs, _ = select_best_result(
+        [with_val(0.5, 0.7), with_val(5.0, 0.9)], [Auc()])
+    assert configs["fixed"].regularization_weight == 5.0
