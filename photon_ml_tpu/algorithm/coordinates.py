@@ -297,12 +297,17 @@ class StreamingFixedEffectCoordinate:
     configurations stream-train through the resident assembled path,
     which reuses the full one-shot machinery.
 
-    ``mesh`` (a 1-D `jax.sharding.Mesh`, `--mesh-devices`) activates the
-    device fold: the cache must be placed on the same devices
+    ``mesh`` (`--mesh-devices` / `--mesh-shape`) activates the device
+    fold: the cache must be placed on the same devices
     (`DeviceShardCache.from_stream(devices=...)`); per-shard partials
     accumulate on their own device and combine in fixed shard order, so
     the solved model is bit-identical for every mesh size
-    (ops/sharded_objective.py).
+    (ops/sharded_objective.py). A 2-D (data x model) mesh
+    (`make_mesh_2d(R, C)`, C > 1) additionally shards the coefficient
+    dimension: the cache must then be built with ``col_blocks=C``, and
+    the solved model stays bitwise-identical across mesh shapes
+    {1x1, 2x1, 1x2, 2x2} (sharded_objective module docstring; the
+    solver-facing convergence state stays full-width on the host).
     """
 
     name: str
@@ -317,7 +322,7 @@ class StreamingFixedEffectCoordinate:
     # compiled accumulate kernel across grid points — the same
     # no-recompile contract as the resident solvers).
     sharded_objective: Optional[object] = None
-    mesh: Optional[object] = None  # 1-D jax.sharding.Mesh (device fold)
+    mesh: Optional[object] = None  # 1-D or 2-D jax.sharding.Mesh (device fold)
 
     def __post_init__(self):
         from photon_ml_tpu.optimization.config import OptimizerType
@@ -345,9 +350,9 @@ class StreamingFixedEffectCoordinate:
                     "shared sharded_objective must wrap the same cache")
             want = None
             if self.mesh is not None:
-                from photon_ml_tpu.parallel import mesh_device_list
+                from photon_ml_tpu.parallel import mesh_fold_devices
 
-                devs = mesh_device_list(self.mesh)
+                devs = mesh_fold_devices(self.mesh)
                 want = devs if len(devs) > 1 else None
             if self.sharded_objective.devices != want:
                 raise ValueError(

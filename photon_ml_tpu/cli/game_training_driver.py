@@ -66,6 +66,33 @@ def _positive_int(s: str) -> int:
     return v
 
 
+def _parse_mesh_shape(s: str) -> tuple:
+    """'RxC' -> (R, C): data-axis x model-axis device extents."""
+    parts = s.strip().lower().split("x")
+    if len(parts) != 2:
+        raise argparse.ArgumentTypeError(
+            f"bad mesh shape {s!r} (expected RxC, e.g. 2x2, 4x1)")
+    try:
+        r, c = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"bad mesh shape {s!r} (expected RxC, e.g. 2x2, 4x1)")
+    if r < 1 or c < 1:
+        raise argparse.ArgumentTypeError(
+            f"mesh extents must be >= 1, got {r}x{c}")
+    return (r, c)
+
+
+def _mesh_shape(args) -> tuple | None:
+    """Resolved (data, model) mesh extents: --mesh-shape RxC, or the
+    back-compat --mesh-devices N == Nx1; None when neither is given."""
+    if args.mesh_shape is not None:
+        return args.mesh_shape
+    if args.mesh_devices is not None:
+        return (args.mesh_devices, 1)
+    return None
+
+
 _BYTE_SUFFIXES = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30, "T": 1 << 40}
 
 
@@ -202,7 +229,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "N (docs/SCALE.md §Training memory envelope). "
                         "Requires --stream-train; N > 1 additionally "
                         "requires --hbm-budget. N=1 is exactly the "
-                        "single-device fold")
+                        "single-device fold. Equivalent to "
+                        "--mesh-shape Nx1")
+    p.add_argument("--mesh-shape", type=_parse_mesh_shape, default=None,
+                   metavar="RxC",
+                   help="fold the --hbm-budget streaming solve over a "
+                        "2-D (data x model) mesh of R x C devices: "
+                        "cached shards place round-robin over the R "
+                        "data rows AND split into C column blocks of "
+                        "the coefficient dimension, one per model-axis "
+                        "device — no device holds a full-width "
+                        "coefficient vector (per-device HBM ~ "
+                        "budget/(R*C), docs/SCALE.md). Margins chain "
+                        "across each row's devices, gradients "
+                        "re-assemble by deterministic column concat, "
+                        "so the model is bit-identical for every "
+                        "shape in {1x1, 2x1, 1x2, 2x2, ...}. "
+                        "Back-compat: --mesh-devices N == Nx1 (pass "
+                        "one of the two). Requires --stream-train; "
+                        "R*C > 1 additionally requires --hbm-budget")
     p.add_argument("--spill-dtype", choices=["f32", "bf16"],
                    default="f32",
                    help="--hbm-budget spill-buffer encoding: 'f32' "
@@ -403,16 +448,22 @@ def _run_training(args, logger, task, emitter, obs):
     evaluators = [build_evaluator(s.strip())
                   for s in (args.evaluators or "").split(",") if s.strip()]
 
-    if args.mesh_devices is not None and not args.stream_train:
+    if args.mesh_shape is not None and args.mesh_devices is not None:
         raise ValueError(
-            "--mesh-devices applies to the --stream-train solve; pass "
-            "--stream-train (and --hbm-budget for a mesh of > 1 device)")
-    if args.mesh_devices is not None and args.mesh_devices > 1 \
+            "--mesh-shape and --mesh-devices are two spellings of the "
+            "same mesh (--mesh-devices N == --mesh-shape Nx1); pass one")
+    mesh_rc = _mesh_shape(args)
+    if mesh_rc is not None and not args.stream_train:
+        raise ValueError(
+            "--mesh-devices/--mesh-shape apply to the --stream-train "
+            "solve; pass --stream-train (and --hbm-budget for a mesh "
+            "of > 1 device)")
+    if mesh_rc is not None and mesh_rc[0] * mesh_rc[1] > 1 \
             and args.hbm_budget is None:
         raise ValueError(
-            "--mesh-devices > 1 requires --hbm-budget: the device fold "
-            "runs over the sharded shard-cache solve (the resident "
-            "assembled path is a single fused device batch)")
+            "a mesh of > 1 device requires --hbm-budget: the device "
+            "fold runs over the sharded shard-cache solve (the "
+            "resident assembled path is a single fused device batch)")
     if args.grid_batched != "auto" and not args.stream_train:
         raise ValueError(
             "--grid-batched applies to the --stream-train λ-grid "
@@ -456,11 +507,11 @@ def _run_training(args, logger, task, emitter, obs):
                 "or factored-random-effect coordinate (plain random "
                 "effects need entity grouping over the full dataset); "
                 f"got sequence {sequence}")
-        if sequence[0] in fre_data and args.mesh_devices is not None:
+        if sequence[0] in fre_data and _mesh_shape(args) is not None:
             raise ValueError(
-                "--mesh-devices is not supported for streamed MF "
-                "coordinates yet (the factor-table device fold is the "
-                "noted follow-on); drop the flag")
+                "--mesh-devices/--mesh-shape are not supported for "
+                "streamed MF coordinates yet (the factor-table device "
+                "fold is the noted follow-on); drop the flag")
         with maybe_trace(args.profile_output_dir):
             if sequence[0] in fre_data:
                 (results, best_configs, best_result, shard_maps,
@@ -876,6 +927,7 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "batch_rows": args.batch_rows,
             "hbm_budget_bytes": None,
             "mesh_devices": args.mesh_devices,
+            "mesh_shape": _mesh_shape(args),
             "spill_dtype": None,  # nothing spills on the resident path
             "spill_source": None,
             "feeder": {k: v for k, v in data.ingest_stats.items()},
@@ -890,15 +942,21 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
         # -- spill: sharded streaming accumulate over the device cache ----
         mesh = None
         devices = None
-        if args.mesh_devices is not None and args.mesh_devices > 1:
-            from photon_ml_tpu.parallel import make_mesh, mesh_device_list
+        mesh_rc = _mesh_shape(args)
+        col_blocks = 1
+        if mesh_rc is not None and mesh_rc[0] * mesh_rc[1] > 1:
+            from photon_ml_tpu.parallel import (
+                make_mesh_2d, mesh_fold_devices,
+            )
 
-            mesh = make_mesh(args.mesh_devices)
-            devices = mesh_device_list(mesh)
+            mesh = make_mesh_2d(mesh_rc[0], mesh_rc[1])
+            devices = mesh_fold_devices(mesh)
+            col_blocks = mesh_rc[1]
         logger.info("stream-train (spill, hbm budget %d bytes%s, "
                     "spill %s/%s): caching %r from %s in %d-row shards",
                     budget,
-                    (f" PER DEVICE x {len(devices)} mesh devices"
+                    (f" PER DEVICE x {len(devices)} mesh devices "
+                     f"({mesh_rc[0]} data x {mesh_rc[1]} model)"
                      if devices else ""), args.spill_dtype,
                     args.spill_source, shard, train_inputs,
                     args.batch_rows)
@@ -917,7 +975,8 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                 make_stream(), shard, hbm_budget_bytes=budget,
                 prefetch_depth=max(0, args.prefetch_batches),
                 devices=devices, spill_dtype=args.spill_dtype,
-                spill_source=args.spill_source, redecode_fetch=fetcher)
+                spill_source=args.spill_source, redecode_fetch=fetcher,
+                col_blocks=col_blocks)
         # Live residency view: a multi-hour spill train's /statusz
         # shows hits/misses/evictions/spill bytes as they happen —
         # mirroring what --serve registers for frontend stats.
@@ -994,6 +1053,7 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
             "batch_rows": args.batch_rows,
             "hbm_budget_bytes": budget,
             "mesh_devices": args.mesh_devices,
+            "mesh_shape": mesh_rc,
             "spill_dtype": args.spill_dtype,
             "spill_source": args.spill_source,
             "feeder": cache.ingest_stats,
@@ -1249,6 +1309,7 @@ def _stream_train_mf(args, logger, task, fre_data, fre_opt, sequence,
         "batch_rows": args.batch_rows,
         "hbm_budget_bytes": budget,
         "mesh_devices": None,  # factor-table device fold: follow-on
+        "mesh_shape": None,
         "spill_dtype": args.spill_dtype if budget is not None else None,
         "spill_source": (args.spill_source if budget is not None
                          else None),

@@ -344,13 +344,22 @@ class DeviceFactorCache:
     eviction uses the same furthest-next-use rule as the feature
     cache. ``redecode`` (set per sweep via :meth:`set_redecode`) is the
     observation-side re-derivation hook: ``fn(shard_index) -> device
-    f32[e_pad, k]``, required on a miss in the ``redecode`` tier."""
+    f32[e_pad, k]``, required on a miss in the ``redecode`` tier.
+
+    ``devices`` (optional) shards the factor tables over a model-axis
+    device list: shard ``i`` lives on ``devices[i % len(devices)]``,
+    mirroring the feature cache's round-robin. Placement happens at
+    every write/restore boundary, so spill re-uploads and redecodes
+    land back on the shard's home device. ``devices=None`` (the
+    default) skips placement entirely — that path is byte-identical
+    to the single-device cache."""
 
     def __init__(self, plan: FactorPlan, num_factors: int,
                  hbm_budget_bytes: Optional[int] = None,
                  spill_dtype: str = "f32",
                  spill_source: str = "buffer",
-                 redecode: Optional[Callable] = None):
+                 redecode: Optional[Callable] = None,
+                 devices: Optional[List] = None):
         if spill_dtype not in FACTOR_SPILL_DTYPES:
             raise ValueError(
                 f"spill_dtype must be one of {FACTOR_SPILL_DTYPES}, got "
@@ -373,6 +382,7 @@ class DeviceFactorCache:
         self.spill_dtype = spill_dtype
         self.spill_source = spill_source
         self._redecode = redecode
+        self.devices = list(devices) if devices else None
         self._entries = [FactorShard(spec=s, _k=self.k)
                          for s in plan.shards]
         self._stats = {"hits": 0, "misses": 0, "evictions": 0,
@@ -383,6 +393,22 @@ class DeviceFactorCache:
         _G_SPILL_HOST.set(0)
 
     # -- wiring ------------------------------------------------------------
+
+    def _place(self, index: int, gamma):
+        """Home-device placement for one shard's table (round-robin
+        over ``devices``); identity when the cache is single-device."""
+        if self.devices is None:
+            return gamma
+        import jax
+
+        return jax.device_put(
+            gamma, self.devices[index % len(self.devices)])
+
+    def shard_device(self, index: int):
+        """The home device of shard ``index``, or None when unplaced."""
+        if self.devices is None:
+            return None
+        return self.devices[index % len(self.devices)]
 
     def set_redecode(self, fn: Optional[Callable]) -> None:
         """Install the observation-side re-derivation hook for the
@@ -426,6 +452,7 @@ class DeviceFactorCache:
                 f"expected {(e.spec.e_pad, self.k)}")
         if self.spill_dtype == "bf16":
             gamma = _quantize_jit()(gamma)
+        gamma = self._place(index, gamma)
         if e.gamma is None:
             self.device_bytes += e.factor_bytes
         e.gamma = gamma
@@ -480,7 +507,7 @@ class DeviceFactorCache:
                 "hbm budget?)")
         self._stats["bytes_reuploaded"] += reupload
         _M_REUPLOAD_BYTES.inc(reupload)
-        e.gamma = gamma
+        e.gamma = self._place(index, gamma)
         self.device_bytes += e.factor_bytes
         self.peak_device_bytes = max(self.peak_device_bytes,
                                      self.device_bytes)
@@ -546,6 +573,7 @@ class DeviceFactorCache:
             "spill_dtype": self.spill_dtype,
             "spill_source": self.spill_source,
             "spill_bytes_host": self.spill_bytes_host,
+            "devices": len(self.devices) if self.devices else None,
             "resident_shards": sum(1 for e in self._entries
                                    if e.gamma is not None),
         })

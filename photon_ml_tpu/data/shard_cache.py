@@ -79,6 +79,26 @@ in shard order regardless of which device computed them). A single
 device (or ``devices=None``) is EXACTLY the PR-5 single-pool cache,
 bit for bit.
 
+With ``col_blocks=C`` (> 1) the cache keys feature blocks by
+(row-shard, column-block) for a 2-D ``(data, model)`` mesh
+(``--mesh-shape RxC``): each streamed batch's CSR matrix is cut into C
+contiguous column blocks of ``ceil(d / C)`` columns
+(`parallel.distributed.split_csr_columns` — scipy's canonical column
+slice, so each block's nnz stream is an order-preserving subsequence
+of the full stream), each block padded to its OWN nnz bucket with
+LOCAL column ids, spilled/restored through its OWN SpillBlock, and
+placed on device slot ``(i % R) * C + c`` of the flat row-major
+``devices`` list (R = len(devices) / C). Row-space columns live once
+per shard on the row's HOME device ``grid[i % R][C-1]`` — the last
+column block's device, where the 2-D objective's margin chain ends.
+``hbm_budget_bytes`` still binds PER device slot and the Belady rule
+is per-(row, col)-slot: a slot's resident column slices are an
+index-arithmetic subsequence of the shard order (slices in slot s all
+have index = s // C mod R), so the global cyclic distance ranks them
+exactly as the slot's own replay cycle does — same argument as the
+1-D round-robin. ``col_blocks=1`` is EXACTLY the 1-D cache, bit for
+bit.
+
 The reference's analog is treeAggregate over cached RDD partitions
 (`ValueAndGradientAggregator.scala:243-274`): no node ever holds the whole
 dataset, partials combine in a fixed deterministic order.
@@ -419,6 +439,32 @@ def assemble_fixed_effect_batch(
 
 
 @dataclasses.dataclass
+class ColumnSlice:
+    """One (row-shard, column-block) feature unit of a ``col_blocks > 1``
+    cache: the shard's nnz entries whose columns fall in
+    ``[c*block_size, (c+1)*block_size)``, padded to the slice's OWN nnz
+    bucket, with LOCAL column ids (``CSRFeatures.n_features ==
+    block_size``). The slice — not the shard — is the unit of placement
+    (device ``grid[i % R][c]``), eviction, and spill."""
+
+    c: int  # column-block index
+    nnz: int  # true entries (<= nnz_bucket)
+    nnz_bucket: int
+    spill: Optional[SpillBlock]  # host spill record; None = no host copy
+    feats: Optional[CSRFeatures] = None  # None = spilled
+    device: object = None
+    slot: int = 0  # (index % R) * C + c
+
+    @property
+    def feature_bytes(self) -> int:
+        return 12 * self.nnz_bucket
+
+    @property
+    def spill_bytes(self) -> int:
+        return 0 if self.spill is None else self.spill.nbytes
+
+
+@dataclasses.dataclass
 class CachedShard:
     """One streamed batch as a static-shape device block.
 
@@ -428,7 +474,13 @@ class CachedShard:
     margin-cached line search feature-pass-free. The FEATURE triplet
     (``feats``) is the evictable part; ``spill`` is the host record it
     restores from (None in the ``redecode`` tier, where a miss re-decodes
-    the source Avro rows instead)."""
+    the source Avro rows instead).
+
+    With ``col_blocks > 1`` the feature triplet is split into per-column
+    ``ColumnSlice`` units (``cols``; ``feats``/``spill`` stay None and
+    ``nnz_bucket`` is unused) and ``device``/``slot`` are the row's HOME
+    placement — the LAST column block's device, where labels/offsets/
+    weights and the 2-D objective's row-space state live."""
 
     index: int
     n_rows: int  # true rows (<= rows_bucket)
@@ -443,16 +495,21 @@ class CachedShard:
     feats: Optional[CSRFeatures] = None  # None = spilled
     device: object = None  # mesh placement; None = default device
     slot: int = 0  # mesh slot (index % n_devices); 0 without a mesh
+    cols: Optional[List[ColumnSlice]] = None  # col_blocks > 1 units
 
     @property
     def feature_bytes(self) -> int:
         # Device-resident cost: values f32 + col_ids i32 + row_ids i32,
         # at the padded shape (restore always widens back to f32/i32).
+        if self.cols is not None:
+            return sum(s.feature_bytes for s in self.cols)
         return 12 * self.nnz_bucket
 
     @property
     def spill_bytes(self) -> int:
         # Host-resident cost of the spill record (0 for redecode).
+        if self.cols is not None:
+            return sum(s.spill_bytes for s in self.cols)
         return 0 if self.spill is None else self.spill.nbytes
 
 
@@ -465,11 +522,14 @@ class ResidentBlock:
 
     index: int
     n_rows: int
-    feats: CSRFeatures
+    feats: Optional[CSRFeatures]
     labels: object
     offsets: object
     weights: object
     slot: int = 0  # device slot the block (and its partials) live on
+    # col_blocks > 1: per-column feature snapshots (feats is None); the
+    # slot above is the HOME slot where row-space columns live.
+    cols: tuple = ()
 
 
 class DeviceShardCache:
@@ -510,7 +570,8 @@ class DeviceShardCache:
                  spill_dtype: str = "f32",
                  spill_source: str = "buffer",
                  shard_id: Optional[str] = None,
-                 redecode_fetch: Optional[Callable] = None):
+                 redecode_fetch: Optional[Callable] = None,
+                 col_blocks: int = 1):
         if spill_dtype not in SPILL_DTYPES:
             raise ValueError(
                 f"spill_dtype must be one of {SPILL_DTYPES}, got "
@@ -551,16 +612,44 @@ class DeviceShardCache:
         self.devices = (list(devices)
                         if devices is not None and len(devices) > 1
                         else None)
+        self.col_blocks = int(col_blocks)
+        if self.col_blocks < 1:
+            raise ValueError(f"col_blocks must be >= 1, got {col_blocks}")
+        if self.col_blocks > 1:
+            if self.devices is None:
+                raise ValueError(
+                    "col_blocks > 1 places column blocks on a (data, "
+                    "model) device grid — pass devices="
+                    "mesh_fold_devices(make_mesh_2d(R, C))")
+            if len(self.devices) % self.col_blocks:
+                raise ValueError(
+                    f"{len(self.devices)} devices do not tile a grid "
+                    f"with {self.col_blocks} column blocks — need a "
+                    "multiple of col_blocks")
         self.n_slots = len(self.devices) if self.devices else 1
+        # Uniform column-block width (the split_csr_columns rule); the
+        # 2-D objective slices the coefficient vector by it.
+        self.col_block_size = -(-self.n_features // self.col_blocks)
         self._slot_bytes = [0] * self.n_slots
-        for e in entries:
-            if e.feats is not None:
-                self._slot_bytes[e.slot] += e.feature_bytes
+        for _, unit in self._all_units():
+            if unit.feats is not None:
+                self._slot_bytes[unit.slot] += unit.feature_bytes
         self.peak_device_bytes = self.device_bytes
         if hbm_budget_bytes is None:
-            for e in entries:
-                e.spill = None
+            for _, unit in self._all_units():
+                unit.spill = None
         _G_SPILL_HOST.set(self.spill_bytes_host)
+
+    def _all_units(self):
+        """(entry, evictable feature unit) pairs in shard order — the
+        CachedShard itself for col_blocks == 1, its ColumnSlices
+        otherwise."""
+        for e in self._entries:
+            if e.cols is not None:
+                for s in e.cols:
+                    yield e, s
+            else:
+                yield e, e
 
     @property
     def spill_bytes_host(self) -> int:
@@ -586,7 +675,8 @@ class DeviceShardCache:
                     devices: Optional[List] = None,
                     spill_dtype: str = "f32",
                     spill_source: str = "buffer",
-                    redecode_fetch: Optional[Callable] = None
+                    redecode_fetch: Optional[Callable] = None,
+                    col_blocks: int = 1
                     ) -> "DeviceShardCache":
         """Ingest pass: decode (prefetched, via the stream) -> pad to the
         bucket ladder -> upload. Decode of batch k+1 overlaps the H2D of
@@ -628,6 +718,19 @@ class DeviceShardCache:
         devs = (list(devices)
                 if devices is not None and len(devices) > 1 else None)
         n_slots = len(devs) if devs else 1
+        col_blocks = int(col_blocks)
+        if col_blocks > 1:
+            if devs is None:
+                raise ValueError(
+                    "col_blocks > 1 places column blocks on a (data, "
+                    "model) device grid — pass devices="
+                    "mesh_fold_devices(make_mesh_2d(R, C))")
+            if n_slots % col_blocks:
+                raise ValueError(
+                    f"{n_slots} devices do not tile a grid with "
+                    f"{col_blocks} column blocks — need a multiple of "
+                    "col_blocks")
+        n_row_slots = n_slots // col_blocks
         entries: List[CachedShard] = []
         n_rows = 0
         d = None
@@ -647,17 +750,16 @@ class DeviceShardCache:
                     max_rows=next_pow2(ds.num_rows))
             rb = ladder.rows_bucket(ds.num_rows)
             nb = ladder.nnz_bucket(mat.nnz, rb)
-            slot = len(entries) % n_slots
+            if col_blocks > 1:
+                # Row-space columns live on the row's HOME device — the
+                # LAST column block's slot, where the 2-D objective's
+                # margin chain ends (ops/sharded_objective.py).
+                slot = (len(entries) % n_row_slots) * col_blocks \
+                    + (col_blocks - 1)
+            else:
+                slot = len(entries) % n_slots
             dev = devs[slot] if devs else None
             with span("shard_upload"):
-                values, cols, rows = padded_csr_arrays(
-                    mat, rb, nb, value_dtype=dtype)
-                spill = None
-                if keep_buffers:
-                    spill = encode_spill(values, cols, rows,
-                                         int(mat.nnz), spill_dtype)
-                    spill_written += spill.nbytes
-                    _M_SPILL_WRITTEN.inc(spill.nbytes)
 
                 def col(x):
                     out = np.zeros(rb, dtype)
@@ -665,24 +767,64 @@ class DeviceShardCache:
                     return (jnp.asarray(out) if dev is None
                             else jax.device_put(out, dev))
 
-                def idx(x):
-                    return (jnp.asarray(x) if dev is None
-                            else jax.device_put(x, dev))
+                def idx(x, d_=None):
+                    d_ = dev if d_ is None else d_
+                    return (jnp.asarray(x) if d_ is None
+                            else jax.device_put(x, d_))
 
-                if spill is not None and spill.dtype_tag != "f32":
-                    # Lossy spill encodings quantize AT INGEST: every
-                    # block's device values take the same encode->
-                    # restore round trip whether or not it ever spills,
-                    # so bf16 replays stay deterministic AND residency-
-                    # independent (a path-dependent precision profile —
-                    # resident blocks f32, once-evicted blocks bf16 —
-                    # would make model bits depend on eviction history).
-                    feats = restore_spilled_features(spill, rb, int(d),
-                                                     dev)
+                def build_unit(sub, sub_nnz, nb_u, width, u_dev):
+                    """Pad + spill-encode + upload one feature unit
+                    (the whole shard, or one column slice)."""
+                    nonlocal spill_written
+                    values, cols_a, rows_a = padded_csr_arrays(
+                        sub, rb, nb_u, value_dtype=dtype)
+                    sp = None
+                    if keep_buffers:
+                        sp = encode_spill(values, cols_a, rows_a,
+                                          sub_nnz, spill_dtype)
+                        spill_written += sp.nbytes
+                        _M_SPILL_WRITTEN.inc(sp.nbytes)
+                    if sp is not None and sp.dtype_tag != "f32":
+                        # Lossy spill encodings quantize AT INGEST:
+                        # every block's device values take the same
+                        # encode->restore round trip whether or not it
+                        # ever spills, so bf16 replays stay
+                        # deterministic AND residency-independent (a
+                        # path-dependent precision profile — resident
+                        # blocks f32, once-evicted blocks bf16 — would
+                        # make model bits depend on eviction history).
+                        f = restore_spilled_features(sp, rb, width,
+                                                     u_dev)
+                    else:
+                        f = CSRFeatures(
+                            chunked_device_put(values, device=u_dev),
+                            idx(cols_a, u_dev), idx(rows_a, u_dev),
+                            rb, width)
+                    return sp, f
+
+                if col_blocks > 1:
+                    from photon_ml_tpu.parallel.distributed import (
+                        split_csr_columns,
+                    )
+
+                    bs_cols, subs = split_csr_columns(mat, col_blocks)
+                    r_slot = len(entries) % n_row_slots
+                    slices = []
+                    for c, sub in enumerate(subs):
+                        c_slot = r_slot * col_blocks + c
+                        c_dev = devs[c_slot]
+                        nb_c = ladder.nnz_bucket(int(sub.nnz), rb)
+                        sp, f = build_unit(sub, int(sub.nnz), nb_c,
+                                           bs_cols, c_dev)
+                        slices.append(ColumnSlice(
+                            c=c, nnz=int(sub.nnz), nnz_bucket=nb_c,
+                            spill=sp, feats=f, device=c_dev,
+                            slot=c_slot))
+                    spill, feats, cols_list = None, None, slices
                 else:
-                    feats = CSRFeatures(
-                        chunked_device_put(values, device=dev), idx(cols),
-                        idx(rows), rb, int(d))
+                    spill, feats = build_unit(mat, int(mat.nnz), nb,
+                                              int(d), dev)
+                    cols_list = None
                 e = CachedShard(
                     index=len(entries), n_rows=ds.num_rows,
                     nnz=int(mat.nnz), rows_bucket=rb, nnz_bucket=nb,
@@ -691,24 +833,31 @@ class DeviceShardCache:
                     weights=col(ds.weights),
                     spill=spill,
                     feats=feats,
-                    device=dev, slot=slot,
+                    device=dev, slot=slot, cols=cols_list,
                 )
             entries.append(e)
             n_rows += ds.num_rows
-            slot_bytes[slot] += e.feature_bytes
+            new_units = e.cols if e.cols is not None else [e]
+            for nu in new_units:
+                slot_bytes[nu.slot] += nu.feature_bytes
             peak_bytes = max(peak_bytes, sum(slot_bytes))
             if hbm_budget_bytes is not None:
-                # Evict-as-you-go on the block's OWN device: the budget
-                # is per device, and eviction stays most-recent-first
-                # (keep the prefix), never the block just uploaded.
-                for victim in reversed(entries[:-1]):
-                    if slot_bytes[slot] <= hbm_budget_bytes:
-                        break
-                    if victim.slot == slot and victim.feats is not None:
-                        victim.feats = None
-                        slot_bytes[slot] -= victim.feature_bytes
-                        evictions += 1
-                        _M_EVICTIONS.inc()
+                # Evict-as-you-go on each new unit's OWN device slot:
+                # the budget is per device, and eviction stays
+                # most-recent-first (keep the prefix), never the block
+                # just uploaded.
+                for nu in new_units:
+                    sl = nu.slot
+                    for victim in reversed(entries[:-1]):
+                        if slot_bytes[sl] <= hbm_budget_bytes:
+                            break
+                        vu = (victim.cols[sl % col_blocks]
+                              if victim.cols is not None else victim)
+                        if vu.slot == sl and vu.feats is not None:
+                            vu.feats = None
+                            slot_bytes[sl] -= vu.feature_bytes
+                            evictions += 1
+                            _M_EVICTIONS.inc()
         if not entries:
             raise ValueError("stream yielded no rows to cache")
         cache = cls(entries, n_rows, int(d), dtype,
@@ -716,7 +865,8 @@ class DeviceShardCache:
                     prefetch_depth=prefetch_depth,
                     ingest_stats=stream.stats(), devices=devs,
                     spill_dtype=spill_dtype, spill_source=spill_source,
-                    shard_id=shard_id, redecode_fetch=redecode_fetch)
+                    shard_id=shard_id, redecode_fetch=redecode_fetch,
+                    col_blocks=col_blocks)
         cache._stats["evictions"] += evictions
         cache._stats["spill_bytes_written"] += spill_written
         cache.peak_device_bytes = max(cache.peak_device_bytes, peak_bytes)
@@ -741,7 +891,15 @@ class DeviceShardCache:
         return list(self._entries)
 
     def bucket_shapes(self) -> set:
+        if self.col_blocks > 1:
+            return {(e.rows_bucket, s.nnz_bucket)
+                    for e in self._entries for s in e.cols}
         return {(e.rows_bucket, e.nnz_bucket) for e in self._entries}
+
+    def _entry_resident(self, e: CachedShard) -> bool:
+        if e.cols is not None:
+            return all(s.feats is not None for s in e.cols)
+        return e.feats is not None
 
     def _enforce_budget(self, pinned: int) -> None:
         """Evict until within budget — PER DEVICE slot under a mesh (the
@@ -762,14 +920,15 @@ class DeviceShardCache:
         for slot in range(self.n_slots):
             if self._slot_bytes[slot] <= budget:
                 continue
-            resident = [e for e in self._entries
-                        if e.feats is not None and e.index != pinned
-                        and e.slot == slot]
+            resident = [(e, u) for e, u in self._all_units()
+                        if u.feats is not None and e.index != pinned
+                        and u.slot == slot]
             # descending cyclic distance (j - cur) mod n: furthest-next-
-            # use first; ties impossible (indexes are unique).
-            resident.sort(key=lambda e: -((e.index - cur) % n))
+            # use first; ties impossible (a slot holds at most one unit
+            # per shard index).
+            resident.sort(key=lambda p: -((p[0].index - cur) % n))
             while self._slot_bytes[slot] > budget and resident:
-                victim = resident.pop(0)
+                _, victim = resident.pop(0)
                 victim.feats = None
                 self._slot_bytes[slot] -= victim.feature_bytes
                 self._stats["evictions"] += 1
@@ -806,6 +965,93 @@ class DeviceShardCache:
                        enc_rows=rows, dtype_tag="f32"),
             e.rows_bucket, self.n_features, e.device)
 
+    def _redecode_2d(self, e: CachedShard, missing: List[ColumnSlice]
+                     ) -> None:
+        """redecode-tier miss for a col_blocks > 1 entry: ONE row-range
+        fetch re-decodes the batch, the column cut re-slices it (the
+        same deterministic `split_csr_columns` cut as ingest), and only
+        the MISSING slices re-pad and re-upload — each to its own
+        (row, col) device."""
+        from photon_ml_tpu.parallel.distributed import split_csr_columns
+
+        fetch = self._redecode_fetch
+        before = getattr(fetch, "payload_bytes_read", None)
+        with span("shard_redecode"):
+            ds = fetch(e.row_offset, e.n_rows)
+            mat = ds.feature_shards[self._shard_id].tocsr()
+            if mat.shape[0] != e.n_rows or int(mat.nnz) != e.nnz:
+                raise RuntimeError(
+                    f"re-decoded shard {e.index} does not match the "
+                    f"ingested block: got {mat.shape[0]} rows/{mat.nnz} "
+                    f"nnz, cached {e.n_rows}/{e.nnz} — the input "
+                    "changed under the cache")
+            _, subs = split_csr_columns(mat, self.col_blocks)
+            payloads = {}
+            for s in missing:
+                sub = subs[s.c]
+                values, cols, rows = padded_csr_arrays(
+                    sub, e.rows_bucket, s.nnz_bucket,
+                    value_dtype=self.dtype)
+                payloads[s.c] = (values, cols, rows, int(sub.nnz))
+        self._stats["redecodes"] += 1
+        after = getattr(fetch, "payload_bytes_read", None)
+        redecoded = (after - before if before is not None
+                     and after is not None
+                     else sum(s.feature_bytes for s in missing))
+        self._stats["bytes_redecoded"] += redecoded
+        _M_REDECODE_BYTES.inc(redecoded)
+        for s in missing:
+            values, cols, rows, sub_nnz = payloads[s.c]
+            s.feats = restore_spilled_features(
+                SpillBlock(nnz=sub_nnz, enc_values=values, enc_cols=cols,
+                           enc_rows=rows, dtype_tag="f32"),
+                e.rows_bucket, self.col_block_size, s.device)
+
+    def _ensure_2d(self, e: CachedShard) -> ResidentBlock:
+        """col_blocks > 1 residency: a miss restores each evicted
+        column slice to ITS OWN (row, col) device; the snapshot carries
+        the per-column feature triplets in column order."""
+        missing = [s for s in e.cols if s.feats is None]
+        if missing:
+            self._stats["misses"] += 1
+            _M_MISSES.inc()
+            reupload = 0
+            for s in missing:
+                if s.spill is not None:
+                    reupload += (s.spill.nbytes
+                                 if s.spill.dtype_tag != "f32"
+                                 else s.feature_bytes)
+                elif self._redecode_fetch is not None:
+                    reupload += s.feature_bytes
+                else:
+                    raise RuntimeError(
+                        f"shard {e.index} column block {s.c} was "
+                        "evicted but has no spill buffers (cache built "
+                        "without an hbm budget)")
+            self._stats["bytes_reuploaded"] += reupload
+            _M_REUPLOAD_BYTES.inc(reupload)
+            for s in missing:
+                self._slot_bytes[s.slot] += s.feature_bytes
+            self.peak_device_bytes = max(self.peak_device_bytes,
+                                         self.device_bytes)
+            _G_PEAK_BYTES.set(self.peak_device_bytes)
+            if missing[0].spill is not None:
+                with span("shard_reupload"):
+                    for s in missing:
+                        s.feats = restore_spilled_features(
+                            s.spill, e.rows_bucket, self.col_block_size,
+                            s.device)
+            else:
+                self._redecode_2d(e, missing)
+            self._enforce_budget(pinned=e.index)
+        else:
+            self._stats["hits"] += 1
+            _M_HITS.inc()
+        return ResidentBlock(index=e.index, n_rows=e.n_rows, feats=None,
+                             labels=e.labels, offsets=e.offsets,
+                             weights=e.weights, slot=e.slot,
+                             cols=tuple(s.feats for s in e.cols))
+
     def ensure(self, index: int) -> ResidentBlock:
         """Return a resident snapshot of the block, restoring it on a
         miss (async put — the caller overlaps it with whatever it is
@@ -813,6 +1059,8 @@ class DeviceShardCache:
         record (`restore_spilled_features`), the redecode tier
         re-decodes the source Avro rows (`_redecode`)."""
         e = self._entries[index]
+        if e.cols is not None:
+            return self._ensure_2d(e)
         if e.feats is None:
             self._stats["misses"] += 1
             _M_MISSES.inc()
@@ -884,14 +1132,19 @@ class DeviceShardCache:
             "spill_source": self.spill_source,
             "spill_bytes_host": self.spill_bytes_host,
             "resident_shards": sum(1 for e in self._entries
-                                   if e.feats is not None),
+                                   if self._entry_resident(e)),
             # Mesh placement: hbm_budget_bytes binds PER device, so the
-            # per-device breakdown is the budget-compliance view.
+            # per-device breakdown is the budget-compliance view. With
+            # col_blocks > 1 the per-slot unit is a COLUMN SLICE, slots
+            # are row-major over the (R, C) grid.
             "mesh_devices": len(self.devices) if self.devices else None,
+            "col_blocks": self.col_blocks,
+            "col_block_size": (self.col_block_size
+                               if self.col_blocks > 1 else None),
             "per_device_bytes": list(self._slot_bytes),
             "per_device_resident_shards": [
-                sum(1 for e in self._entries
-                    if e.feats is not None and e.slot == slot)
+                sum(1 for _, u in self._all_units()
+                    if u.feats is not None and u.slot == slot)
                 for slot in range(self.n_slots)],
         })
         return s

@@ -22,6 +22,39 @@ only [d]-vectors cross the interconnect: the coefficient/direction
 broadcast out (D-1 puts per pass — the reference's per-evaluation
 coefficient broadcast), the per-shard partials back in.
 
+**2-D (data x model) regime.** A 2-D mesh (`parallel.make_mesh_2d`,
+R x C with C > 1) additionally shards the COEFFICIENT dimension: the
+cache keys feature blocks by (row-shard, column-block) on the (data,
+model) device grid (`DeviceShardCache(col_blocks=C)`), and this module
+builds one kernel kit per mesh COORDINATE — a row kit on each data
+row's home device grid[r][C-1] (row-space state: margins, labels,
+value/u partials) and a column kit per (r, c) contracting only its
+column slice. The full-width [d] broadcast is replaced by per-column
+[block_size] slices (`_put_col_slices`), margins chain left-to-right
+across each row's devices (`_chain_margins` — bitwise the full matvec,
+column-kit docstring), rmatvec partials fold per column along the data
+axis (ordered left-fold on grid[0][c], the PR-7 association per
+coefficient slice), and the model-axis combine is a deterministic
+host-side concat in ascending column order — so no mesh device ever
+materializes the full coefficient vector, and the whole 2-D reduce is
+elementwise the same addition order as the non-mesh fold: mesh shapes
+{1x1, 2x1, 1x2, 2x2} produce bitwise-identical value/grad/Hvp and full
+solves. Host-side solver convergence state STAYS FULL-WIDTH (the
+solvers are unchanged; gradients re-assemble at the apex) — blocked
+solver state is a follow-on, see ROADMAP. C > 1 requires
+``combine="ordered"``.
+
+One measured exception (same spirit as the bf16 caveat): with
+SHIFTS-normalization the margin-shift dot ``-(eff @ shifts)`` moves
+from the fused per-shard kernels into the apex `norm_prep` executable,
+and a [d]-dot's reduction association is executable-dependent — the
+extracted shift can differ from the fused one by ~1 ulp
+(value-dependent; measured on virtual CPU devices). Factors-only
+normalization is elementwise (no reduction) and stays exactly bitwise,
+as does ``normalization=None``. Shifts-normalized 2-D results are
+still deterministic for a fixed mesh shape; across shapes they agree
+to the documented 1-ulp shift bound rather than bit for bit.
+
 Cross-device combine (both are fixed-order reductions; neither ever
 depends on arrival timing):
 
@@ -119,7 +152,22 @@ KERNEL_FAMILIES = 8
 #: telemetry federation; docs/OBSERVABILITY.md).
 _M_GRID_PASSES = telemetry.counter("training.grid.feature_passes")
 
+# Mesh-shape gauges + per-axis interconnect traffic (docs/
+# OBSERVABILITY.md; merge policies in telemetry/federation.py). The
+# data axis carries partials folding toward the apex and broadcasts
+# replicated across row devices; the model axis carries the z-chain
+# hops between column blocks, the u/t row-space broadcasts home ->
+# column devices, and the per-column coefficient-slice puts.
+_G_MESH_DATA = telemetry.gauge("training.mesh.data_axis_devices")
+_G_MESH_MODEL = telemetry.gauge("training.mesh.model_axis_devices")
+_M_DATA_XFER = telemetry.counter("training.mesh.data_axis_transfer_bytes")
+_M_MODEL_XFER = telemetry.counter("training.mesh.model_axis_transfer_bytes")
+
 _NULL_SPAN = contextlib.nullcontext()
+
+
+def _tree_nbytes(x) -> int:
+    return sum(getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(x))
 
 
 class _Fold:
@@ -159,6 +207,7 @@ class _OrderedFold(_Fold):
 
     def add(self, slot, part):
         with span("cross_device_combine"):
+            _M_DATA_XFER.inc(_tree_nbytes(part))
             part = jax.device_put(part, self.s.devices[0])
             self.acc = part if self.acc is None \
                 else self.combine_fn(self.acc, part)
@@ -184,9 +233,44 @@ class _LocalFold(_Fold):
             for part in self.accs:
                 if part is None:
                     continue
+                _M_DATA_XFER.inc(_tree_nbytes(part))
                 part = jax.device_put(part, self.s.devices[0])
                 acc = part if acc is None else self.combine_fn(acc, part)
         return acc
+
+
+class _ColFold:
+    """2-D combine for per-column-block ``[block_size]`` partials: each
+    column ``c`` left-folds in GLOBAL shard order on its own fold
+    device ``grid[0][c]`` (an ordered data-axis fold per column — the
+    PR-7 association per coefficient slice), and ``result_host()``
+    concatenates the C folded slices on the HOST in ascending column
+    order — a deterministic model-axis concat, exact by construction
+    (concatenation reorders no additions). No mesh device ever holds
+    the full ``[d]`` vector; the full-width apex gradient exists only
+    in host/default-device solver state."""
+
+    def __init__(self, sobj: "ShardedGLMObjective", grid: bool = False):
+        self.s = sobj
+        # One combine executable PER COLUMN (its fold device is fixed,
+        # so each instance traces once per partial structure — a shared
+        # jit would retrace per column device, scaling compiles with the
+        # model extent instead of with structures).
+        self.fns = sobj._k_col_combine
+        self.cols: List = [None] * sobj.col_blocks
+
+    def add(self, c: int, part):
+        with span("cross_device_combine"):
+            _M_DATA_XFER.inc(_tree_nbytes(part))
+            part = jax.device_put(part, self.s.grid2d[0][c])
+            self.cols[c] = part if self.cols[c] is None \
+                else self.fns[c](self.cols[c], part)
+
+    def result_host(self) -> np.ndarray:
+        with span("model_axis_concat"):
+            parts = [np.asarray(p) for p in self.cols]
+            _M_MODEL_XFER.inc(sum(p.nbytes for p in parts))
+        return np.concatenate(parts, axis=-1)[..., :self.s.dim]
 
 
 class ShardedGLMObjective:
@@ -220,17 +304,25 @@ class ShardedGLMObjective:
         self.combine = combine
 
         devices = None
+        grid2d = None
+        col_blocks = 1
         if mesh is not None:
-            from photon_ml_tpu.parallel.distributed import mesh_device_list
+            from photon_ml_tpu.parallel.distributed import mesh_grid_2d
 
-            devices = mesh_device_list(mesh)
-            if len(devices) <= 1:
-                # A 1-device mesh IS the single-device fold — same code
-                # path, same kernels, same bits as mesh=None.
-                devices = None
+            n_data, n_model, g2d = mesh_grid_2d(mesh)
+            if n_data * n_model > 1:
+                devices = [d for row in g2d for d in row]
+                if n_model > 1:
+                    grid2d = g2d
+                    col_blocks = n_model
         self.mesh = mesh if devices is not None else None
         self.devices = devices
+        self.grid2d = grid2d
+        self.col_blocks = col_blocks
+        self.data_rows = (1 if devices is None
+                          else len(devices) // col_blocks)
         cache_devs = getattr(cache, "devices", None)
+        cache_cols = int(getattr(cache, "col_blocks", 1) or 1)
         if devices is not None:
             if cache_devs is None or list(cache_devs) != list(devices):
                 raise ValueError(
@@ -247,14 +339,51 @@ class ShardedGLMObjective:
                 f"cache is placed on {len(cache_devs)} mesh devices but "
                 "the objective was built without a mesh — pass "
                 "mesh=make_mesh(len(cache.devices))")
+        if cache_cols != col_blocks:
+            raise ValueError(
+                f"cache was built with col_blocks={cache_cols} but the "
+                f"mesh has {col_blocks} model-axis devices — build the "
+                "DeviceShardCache with col_blocks matching the mesh's "
+                "model extent")
+        if col_blocks > 1 and combine != "ordered":
+            raise ValueError(
+                "combine='local' is not supported with a model axis "
+                "(col_blocks > 1): per-column partials fold in ordered "
+                "shard order only — use combine='ordered' or a 1-D mesh")
+        if devices is not None:
+            _G_MESH_DATA.set(self.data_rows)
+            _G_MESH_MODEL.set(col_blocks)
+        self.block_size = int(getattr(cache, "col_block_size", 0) or 0) \
+            if col_blocks > 1 else 0
 
         # Kernels are built per INSTANCE and per MESH DEVICE (closures
         # over the stable objective), so each device's executables — and
         # their trace counts in the guard — are its own; one kernel
         # traces once per distinct (rows_bucket, nnz_bucket) it sees.
-        self._tags = ([""] if devices is None
-                      else [f"@d{k}" for k in range(len(devices))])
-        self._kits = [self._build_kit(tag) for tag in self._tags]
+        # With a model axis (col_blocks > 1) the kit splits per mesh
+        # COORDINATE: a row kit per data row r (home slot r*C + C-1,
+        # where row-space state lives) and a column kit per (r, c)
+        # whose kernels contract only that column block's slice.
+        if col_blocks > 1:
+            self._tags = []
+            self._kits = [None] * len(devices)
+            self._row_kits: Dict[int, Dict[str, object]] = {}
+            self._col_kits: List[List[Dict[str, object]]] = []
+            for r in range(self.data_rows):
+                kit = self._build_row_kit(f"@r{r}")
+                self._row_kits[r] = kit
+                self._kits[r * col_blocks + col_blocks - 1] = kit
+                self._col_kits.append(
+                    [self._build_col_kit(f"@r{r}c{c}")
+                     for c in range(col_blocks)])
+            self._norm_kit = self._build_norm_kit()
+        else:
+            self._tags = ([""] if devices is None
+                          else [f"@d{k}" for k in range(len(devices))])
+            self._kits = [self._build_kit(tag) for tag in self._tags]
+            self._row_kits = {}
+            self._col_kits = []
+            self._norm_kit = None
         if devices is not None:
             # Apex combine kernel (fold device): partials arrive as
             # committed transfers, one trace per partial STRUCTURE.
@@ -263,21 +392,34 @@ class ShardedGLMObjective:
 
             self._k_combine = jax.jit(combine_kernel)
             self.guard.track("sharded:combine", self._k_combine)
+        self._k_col_combine: List = []
+        if col_blocks > 1:
+            for c in range(col_blocks):
+                def col_combine_kernel(acc, part):
+                    return jax.tree.map(jnp.add, acc, part)
+
+                fn = jax.jit(col_combine_kernel)
+                self.guard.track(f"sharded:col_combine@c{c}", fn)
+                self._k_col_combine.append(fn)
         # Grid kits (vmapped-over-λ twins of the scalar kernels) are
         # built lazily on the first grid_* call: a sequential sweep
         # never pays their compiles, and trace_budgets() only mentions
         # kernels that exist.
         self._grid_kits: Optional[List[Dict[str, object]]] = None
+        self._grid_row_kits: Dict[int, Dict[str, object]] = {}
+        self._grid_col_kits: Optional[List[List[Dict[str, object]]]] = None
+        self._grid_norm_kit = None
         self._k_grid_combine = None
-        # Back-compat aliases (tests poke individual kernels).
-        kit0 = self._kits[0]
-        self._k_init = kit0["init"]
-        self._k_dir = kit0["dir"]
-        self._k_trial = kit0["trial"]
-        self._k_grad = kit0["grad"]
-        self._k_curv = kit0["curv"]
-        self._k_hvp = kit0["hvp"]
-        self._k_acc = kit0["acc"]
+        if col_blocks == 1:
+            # Back-compat aliases (tests poke individual kernels).
+            kit0 = self._kits[0]
+            self._k_init = kit0["init"]
+            self._k_dir = kit0["dir"]
+            self._k_trial = kit0["trial"]
+            self._k_grad = kit0["grad"]
+            self._k_curv = kit0["curv"]
+            self._k_hvp = kit0["hvp"]
+            self._k_acc = kit0["acc"]
 
     def _build_kit(self, tag: str) -> Dict[str, object]:
         """One device's kernel kit. Bodies are IDENTICAL across devices
@@ -352,6 +494,120 @@ class ShardedGLMObjective:
         for name, fn in kit.items():
             self.guard.track(f"sharded:{name}{tag}", fn)
         return kit
+
+    def _build_row_kit(self, tag: str) -> Dict[str, object]:
+        """Row-space kernel kit for one DATA row's home device
+        (``grid[r][C-1]``, where the margin chain ends and labels/
+        offsets/weights/margins live). These are the scalar kit's
+        kernels with the feature contraction factored OUT: ``finish``
+        turns the chained linear margins into ``z = z_lin + offsets +
+        shift`` — the exact left-association of ``GLMObjective.margins``
+        — plus the value/u partials; ``dirfin``/``hmid`` mirror
+        ``margin_direction``'s ``(z_lin + offsets + shift) - offsets``.
+        ``u``/``t`` row vectors RETURN from these kernels (instead of
+        being contracted in place) so each column device can rmatvec its
+        own slice. ``trial``/``curv``/``axpy`` are byte-identical to the
+        scalar kit's: the row-space solver passes index `_kits[home]`
+        and never notice the model axis."""
+        obj = self.objective
+
+        def finish_kernel(z_lin, labels, offsets, weights, shift, n: int):
+            z = z_lin + offsets + shift
+            val = jnp.sum((weights * obj.loss.loss(z, labels))[:n])
+            u = weights * obj.loss.d1(z, labels)
+            return z, val, u, jnp.sum(u[:n])
+
+        def dirfin_kernel(z_lin, offsets, shift):
+            return z_lin + offsets + shift - offsets
+
+        def uz_kernel(z, labels, weights, n: int):
+            u = weights * obj.loss.d1(z, labels)
+            return u, jnp.sum(u[:n])
+
+        def hmid_kernel(zp_lin, offsets, shift, d2, n: int):
+            jv = zp_lin + offsets + shift - offsets
+            t = d2 * jv
+            return t, jnp.sum(t[:n])
+
+        def trial_kernel(z, zp, labels, weights, ts, n: int):
+            z_t = z[None, :n] + ts[:, None] * zp[None, :n]
+            return jnp.sum(
+                weights[None, :n] * obj.loss.loss(z_t, labels[None, :n]),
+                axis=-1)
+
+        def curvature_kernel(z, labels, weights):
+            return weights * obj.loss.d2(z, labels)
+
+        def axpy_kernel(a, t, b):
+            return a + t * b
+
+        kit = {
+            "finish": jax.jit(finish_kernel, static_argnames=("n",)),
+            "dirfin": jax.jit(dirfin_kernel),
+            "uz": jax.jit(uz_kernel, static_argnames=("n",)),
+            "hmid": jax.jit(hmid_kernel, static_argnames=("n",)),
+            "trial": jax.jit(trial_kernel, static_argnames=("n",)),
+            "curv": jax.jit(curvature_kernel),
+            "axpy": jax.jit(axpy_kernel),
+        }
+        for name, fn in kit.items():
+            self.guard.track(f"sharded:{name}{tag}", fn)
+        return kit
+
+    def _build_col_kit(self, tag: str) -> Dict[str, object]:
+        """Column-contraction kit for one mesh coordinate (r, c): its
+        kernels touch ONLY that coordinate's column slice (local width
+        ``block_size``), so no device ever materializes a full-width
+        [d] vector. Bitwise contract (pinned by the mesh-shape gate):
+        CSR entries are column-sorted per row, so each column block's
+        nnz stream is an order-preserving subsequence of the full
+        stream, and JAX's segment_sum / ``.at[].add`` scatter-adds
+        apply per-cell in stream order — chaining ``mv0`` (block 0,
+        the full path's own matvec expression) through ``mvacc`` in
+        ascending block order reproduces the full matvec bit for bit,
+        and each block's ``rmv`` equals the corresponding slice of the
+        full rmatvec (pad entries add +0.0: identity on accumulators
+        that start from +0.0)."""
+
+        def mv0_kernel(feats, w):
+            return feats.matvec(w)
+
+        def mvacc_kernel(z_acc, feats, w):
+            return z_acc.at[feats.row_ids].add(
+                feats.values * w[feats.col_ids])
+
+        def rmv_kernel(feats, u):
+            return feats.rmatvec(u)
+
+        kit = {
+            "mv0": jax.jit(mv0_kernel),
+            "mvacc": jax.jit(mvacc_kernel),
+            "rmv": jax.jit(rmv_kernel),
+        }
+        for name, fn in kit.items():
+            self.guard.track(f"sharded:{name}{tag}", fn)
+        return kit
+
+    def _build_norm_kit(self):
+        """Full-width normalization prep, computed ONCE per pass on the
+        default device (the solver's coefficient already lives there
+        full-width — the host-side convergence state decision of
+        optimization/glm_lbfgs.py): (eff, shift) exactly as
+        ``GLMObjective.margins`` derives them, then sliced per column
+        block. None when the objective has no normalization (eff is the
+        coefficient itself; shift stays the same python 0.0 the fused
+        margins adds)."""
+        norm = self.objective.normalization
+        if norm is None:
+            return None
+
+        def norm_prep(coef):
+            return norm.effective_coefficients(coef), \
+                norm.margin_shift(coef)
+
+        fn = jax.jit(norm_prep)
+        self.guard.track("sharded:norm_prep", fn)
+        return fn
 
     def _build_grid_kit(self, tag: str) -> Dict[str, object]:
         """One device's GRID kernel kit: each kernel is the scalar body
@@ -444,10 +700,133 @@ class ShardedGLMObjective:
             self.guard.track(f"sharded:grid_{name}{tag}", fn)
         return kit
 
+    def _build_grid_row_kit(self, tag: str) -> Dict[str, object]:
+        """GRID twin of `_build_row_kit`: row-space bodies vmapped over
+        the leading λ axis (margins `[G, rows]`, shifts `[G]` — or the
+        same scalar 0.0 the fused grid margins broadcast when there is
+        no normalization)."""
+        obj = self.objective
+        sh_axis = 0 if obj.normalization is not None else None
+
+        def grid_finish_kernel(z_lin, labels, offsets, weights, shift,
+                               n: int):
+            def one(zl, sh):
+                z = zl + offsets + sh
+                val = jnp.sum((weights * obj.loss.loss(z, labels))[:n])
+                u = weights * obj.loss.d1(z, labels)
+                return z, val, u, jnp.sum(u[:n])
+
+            return jax.vmap(one, in_axes=(0, sh_axis))(z_lin, shift)
+
+        def grid_dirfin_kernel(z_lin, offsets, shift):
+            return jax.vmap(
+                lambda zl, sh: zl + offsets + sh - offsets,
+                in_axes=(0, sh_axis))(z_lin, shift)
+
+        def grid_uz_kernel(z, labels, weights, n: int):
+            def one(z_g):
+                u = weights * obj.loss.d1(z_g, labels)
+                return u, jnp.sum(u[:n])
+
+            return jax.vmap(one)(z)
+
+        def grid_hmid_kernel(zp_lin, offsets, shift, d2, n: int):
+            def one(zl, sh, d2_g):
+                jv = zl + offsets + sh - offsets
+                t = d2_g * jv
+                return t, jnp.sum(t[:n])
+
+            return jax.vmap(one, in_axes=(0, sh_axis, 0))(
+                zp_lin, shift, d2)
+
+        def grid_trial_kernel(z, zp, labels, weights, ts, n: int):
+            def one(z_g, zp_g, ts_g):
+                z_t = z_g[None, :n] + ts_g[:, None] * zp_g[None, :n]
+                return jnp.sum(
+                    weights[None, :n]
+                    * obj.loss.loss(z_t, labels[None, :n]),
+                    axis=-1)
+
+            return jax.vmap(one)(z, zp, ts)
+
+        def grid_curvature_kernel(z, labels, weights):
+            return jax.vmap(
+                lambda z_g: weights * obj.loss.d2(z_g, labels))(z)
+
+        def grid_axpy_kernel(a, t, b):
+            return jnp.where((t != 0.0)[:, None], a + t[:, None] * b, a)
+
+        kit = {
+            "finish": jax.jit(grid_finish_kernel, static_argnames=("n",)),
+            "dirfin": jax.jit(grid_dirfin_kernel),
+            "uz": jax.jit(grid_uz_kernel, static_argnames=("n",)),
+            "hmid": jax.jit(grid_hmid_kernel, static_argnames=("n",)),
+            "trial": jax.jit(grid_trial_kernel, static_argnames=("n",)),
+            "curv": jax.jit(grid_curvature_kernel),
+            "axpy": jax.jit(grid_axpy_kernel),
+        }
+        for name, fn in kit.items():
+            self.guard.track(f"sharded:grid_{name}{tag}", fn)
+        return kit
+
+    def _build_grid_col_kit(self, tag: str) -> Dict[str, object]:
+        """GRID twin of `_build_col_kit`: the per-lane bodies are the
+        scalar column kernels exactly, vmapped over coefficient panels
+        `[G, block_size]` / margin panels `[G, rows]` — the feature
+        block is closed over once and broadcast across lanes."""
+
+        def grid_mv0_kernel(feats, ws):
+            return jax.vmap(lambda w: feats.matvec(w))(ws)
+
+        def grid_mvacc_kernel(z_acc, feats, ws):
+            return jax.vmap(
+                lambda zl, w: zl.at[feats.row_ids].add(
+                    feats.values * w[feats.col_ids]))(z_acc, ws)
+
+        def grid_rmv_kernel(feats, us):
+            return jax.vmap(lambda u: feats.rmatvec(u))(us)
+
+        kit = {
+            "mv0": jax.jit(grid_mv0_kernel),
+            "mvacc": jax.jit(grid_mvacc_kernel),
+            "rmv": jax.jit(grid_rmv_kernel),
+        }
+        for name, fn in kit.items():
+            self.guard.track(f"sharded:grid_{name}{tag}", fn)
+        return kit
+
+    def _build_grid_norm_kit(self):
+        norm = self.objective.normalization
+        if norm is None:
+            return None
+
+        def grid_norm_prep(coefs):
+            return jax.vmap(
+                lambda c: (norm.effective_coefficients(c),
+                           norm.margin_shift(c)))(coefs)
+
+        fn = jax.jit(grid_norm_prep)
+        self.guard.track("sharded:grid_norm_prep", fn)
+        return fn
+
     def _ensure_grid_kits(self) -> None:
         if self._grid_kits is not None:
             return
-        self._grid_kits = [self._build_grid_kit(t) for t in self._tags]
+        if self.col_blocks > 1:
+            c_blocks = self.col_blocks
+            self._grid_kits = [None] * len(self.devices)
+            self._grid_col_kits = []
+            for r in range(self.data_rows):
+                kit = self._build_grid_row_kit(f"@r{r}")
+                self._grid_row_kits[r] = kit
+                self._grid_kits[r * c_blocks + c_blocks - 1] = kit
+                self._grid_col_kits.append(
+                    [self._build_grid_col_kit(f"@r{r}c{c}")
+                     for c in range(c_blocks)])
+            self._grid_norm_kit = self._build_grid_norm_kit()
+        else:
+            self._grid_kits = [self._build_grid_kit(t)
+                               for t in self._tags]
         if self.devices is not None:
             def grid_combine_kernel(acc, part):
                 return jax.tree.map(jnp.add, acc, part)
@@ -485,6 +864,89 @@ class ShardedGLMObjective:
             return _OrderedFold(self, kits, combine_fn)
         return _LocalFold(self, kits, combine_fn)
 
+    # -- 2-D (data x model) plumbing ---------------------------------------
+
+    def _norm_prep(self, coef):
+        """(eff, shift) exactly as the fused margins derive them —
+        computed ONCE per pass at full width on the default device
+        instead of inside every per-shard kernel (same bits: the prep is
+        the same jnp expressions at the same shapes)."""
+        if self._norm_kit is None:
+            return coef, 0.0
+        eff, shift = self._norm_kit(coef)
+        # The shift rides into every row's finish kernel as an argument:
+        # decommit it (solver inputs may arrive committed) so it follows
+        # the home-device args instead of pinning the jit to two devices.
+        return eff, self._decommit(shift)
+
+    def _grid_norm_prep(self, coefs):
+        if self._grid_norm_kit is None:
+            return coefs, 0.0
+        eff, shift = self._grid_norm_kit(coefs)
+        return eff, self._decommit(shift)
+
+    def _put_col_slices(self, vec) -> List[List[Array]]:
+        """Slice a full-width [d] (or [G, d]) vector into C column
+        blocks of width ``block_size`` (zero-padded tail) and place
+        slice c on every row's column-c device — the 2-D replacement
+        for the full-width `_per_device` broadcast: each device
+        receives 1/C of the coefficient bytes and none ever holds the
+        full vector. Returns ``out[r][c]``."""
+        bs = self.block_size
+        v = np.asarray(vec)
+        pad = self.col_blocks * bs - v.shape[-1]
+        if pad:
+            v = np.pad(v, [(0, 0)] * (v.ndim - 1) + [(0, pad)])
+        out = []
+        for r in range(self.data_rows):
+            row = []
+            for c in range(self.col_blocks):
+                sl = v[..., c * bs:(c + 1) * bs]
+                _M_MODEL_XFER.inc(sl.nbytes)
+                row.append(jax.device_put(sl, self.grid2d[r][c]))
+            out.append(row)
+        return out
+
+    def _chain_margins(self, r: int, cols, w_row, grid: bool = False):
+        """Linear margins for one shard by chaining its column blocks in
+        ascending block order across row r's devices: block 0 computes
+        the full path's own matvec expression, each later block
+        scatter-adds its slice's contribution into the accumulator as it
+        hops one device right — bitwise the full-width matvec (column
+        kit docstring). Ends on the home device grid[r][C-1]."""
+        kits = self._grid_col_kits[r] if grid else self._col_kits[r]
+        with span("col_block_fold:c0"):
+            z = kits[0]["mv0"](cols[0], w_row[0])
+        for c in range(1, self.col_blocks):
+            _M_MODEL_XFER.inc(z.nbytes)
+            z = jax.device_put(z, self.grid2d[r][c])
+            with span(f"col_block_fold:c{c}"):
+                z = kits[c]["mvacc"](z, cols[c], w_row[c])
+        return z
+
+    def _rmv_cols(self, r: int, cols, u, colfold: "_ColFold",
+                  grid: bool = False) -> None:
+        """Fan a home-device row vector ``u`` out to row r's column
+        devices and fold each block's local-width rmatvec partial into
+        the per-column data-axis fold. The c = C-1 contraction runs on
+        the home device itself (u is already there)."""
+        kits = self._grid_col_kits[r] if grid else self._col_kits[r]
+        for c in range(self.col_blocks):
+            u_c = u
+            if c != self.col_blocks - 1:
+                _M_MODEL_XFER.inc(u.nbytes)
+                u_c = jax.device_put(u, self.grid2d[r][c])
+            with span(f"col_block_fold:c{c}"):
+                part = kits[c]["rmv"](cols[c], u_c)
+            colfold.add(c, part)
+
+    @staticmethod
+    def _decommit(x) -> Array:
+        """Pull an apex scalar off its committed fold device so the
+        solver-facing value composes on the default device, exactly like
+        the host-side full-width convergence state it joins."""
+        return jnp.asarray(np.asarray(x))
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -511,6 +973,51 @@ class ShardedGLMObjective:
         partial STRUCTURE (value-grad triple, trial vector, hvp pair),
         independent of buckets."""
         budgets = {}
+        if self.col_blocks > 1:
+            # 2-D kits: budgets per mesh COORDINATE, still in bucket
+            # terms only. Row kernels (home device) bound by the row
+            # buckets that data row holds x the <=2 static true row
+            # counts; column kernels by that coordinate's (rows, nnz)
+            # slice buckets. A wider mesh splits the SAME buckets across
+            # more coordinates — total compiles scale with buckets x
+            # column blocks, never with device count (asserted by the
+            # mesh2d bench and tests).
+            c_blocks = self.col_blocks
+            grid_on = self._grid_kits is not None
+            for r in range(self.data_rows):
+                home = r * c_blocks + c_blocks - 1
+                ents = [e for e in self.cache.entries if e.slot == home]
+                row_buckets = max(1, len({e.rows_bucket for e in ents}))
+                for fam, mult in (("finish", 2), ("dirfin", 1),
+                                  ("uz", 2), ("hmid", 2), ("trial", 4),
+                                  ("curv", 1), ("axpy", 2)):
+                    budgets[f"sharded:{fam}@r{r}"] = mult * row_buckets
+                    if grid_on:
+                        budgets[f"sharded:grid_{fam}@r{r}"] = \
+                            mult * row_buckets
+                for c in range(c_blocks):
+                    shapes = {(e.rows_bucket, e.cols[c].nnz_bucket)
+                              for e in ents}
+                    buckets = max(1, len(shapes))
+                    for fam in ("mv0", "mvacc", "rmv"):
+                        budgets[f"sharded:{fam}@r{r}c{c}"] = buckets
+                        if grid_on:
+                            budgets[f"sharded:grid_{fam}@r{r}c{c}"] = \
+                                buckets
+            # Row-space apex combine folds (val, su) pairs, bare su
+            # scalars, and [K]/[1] trial vectors; each column's own
+            # combine folds its [block_size] slices (+ the [G, bs] grid
+            # twin) on its fixed fold device.
+            budgets["sharded:combine"] = 4
+            for c in range(c_blocks):
+                budgets[f"sharded:col_combine@c{c}"] = 2
+            if grid_on:
+                budgets["sharded:grid_combine"] = 4
+            if self._norm_kit is not None:
+                budgets["sharded:norm_prep"] = 2
+            if self._grid_norm_kit is not None:
+                budgets["sharded:grid_norm_prep"] = 2
+            return budgets
         for slot, tag in enumerate(self._tags):
             shapes = self._slot_bucket_shapes(slot)
             buckets = max(1, len(shapes))
@@ -571,15 +1078,19 @@ class ShardedGLMObjective:
         compiled for. A bf16/delta-encoded spill buffer leaking past
         `restore_spilled_features` would otherwise silently jit-trace a
         SECOND executable per bucket (dtype is part of the signature)
-        and accumulate at the wrong precision."""
-        got = np.dtype(block.feats.values.dtype)
+        and accumulate at the wrong precision. With a model axis the
+        check covers every column slice of the block."""
+        feats_list = (block.cols if getattr(block, "cols", ())
+                      else (block.feats,))
         want = np.dtype(getattr(self.cache, "dtype", np.float32))
-        if got != want:
-            raise TypeError(
-                f"feature block {block.index} reached the sharded "
-                f"accumulate as {got}, kernels were compiled for {want} "
-                "— spill codecs must restore through "
-                "data/shard_cache.py restore_spilled_features")
+        for feats in feats_list:
+            got = np.dtype(feats.values.dtype)
+            if got != want:
+                raise TypeError(
+                    f"feature block {block.index} reached the sharded "
+                    f"accumulate as {got}, kernels were compiled for "
+                    f"{want} — spill codecs must restore through "
+                    "data/shard_cache.py restore_spilled_features")
 
     def _finish_grad(self, g_raw: Array, su: Array, coef: Array,
                      l2) -> Array:
@@ -599,6 +1110,8 @@ class ShardedGLMObjective:
         """One pass over the feature blocks: per-shard margins (kept as
         device row-space state, each on its shard's device), the
         objective value, and the gradient."""
+        if self.col_blocks > 1:
+            return self._margins_value_grad_2d(coef, l2)
         z_list: List[Array] = []
         fold = self._new_fold()
         # The ``accumulate`` span covers the whole host-driven fold:
@@ -644,6 +1157,8 @@ class ShardedGLMObjective:
 
     def margin_direction_list(self, direction: Array) -> List[Array]:
         """Per-shard directional margins (one feature pass)."""
+        if self.col_blocks > 1:
+            return self._margin_direction_list_2d(direction)
         out: List[Array] = []
         with span("accumulate"):
             dirs = self._per_device(direction)
@@ -684,6 +1199,8 @@ class ShardedGLMObjective:
     def grad_from_margins_list(self, coef: Array,
                                z_list: Sequence[Array], l2) -> Array:
         """Gradient given cached margins: one rmatvec pass."""
+        if self.col_blocks > 1:
+            return self._grad_from_margins_list_2d(coef, z_list, l2)
         fold = self._new_fold()
         with span("accumulate"):
             for e, z in zip(self.cache.blocks(), z_list):
@@ -706,6 +1223,8 @@ class ShardedGLMObjective:
         """H @ vec with precomputed curvature: one matvec + one rmatvec
         per shard (the streaming form of
         GLMObjective.hessian_vector_from_margins)."""
+        if self.col_blocks > 1:
+            return self._hessian_vector_2d(vec, d2_list, l2)
         fold = self._new_fold()
         with span("accumulate"):
             vecs = self._per_device(vec)
@@ -718,6 +1237,98 @@ class ShardedGLMObjective:
                 fold.add(e.slot, part)
             r_raw, su = fold.result()
         return self._finish_grad(r_raw, su, vec, l2)
+
+    # -- 2-D (data x model) accumulation passes ----------------------------
+    #
+    # The _2d passes replace each full-width feature contraction with a
+    # per-column-block chain (margins) / fan-out (rmatvec): coefficient
+    # SLICES broadcast out, per-column [block_size] partials fold along
+    # the data axis on the column's own fold device, and the full-width
+    # gradient exists only after the host-side model-axis concat — no
+    # mesh device ever holds a [d] vector. Row-space scalars (value, su)
+    # fold through the SAME ordered data-axis fold as the 1-D mesh, so
+    # the whole pass is elementwise the identical addition order: mesh
+    # shapes {1x1, 2x1, 1x2, 2x2} and the non-mesh fold are bitwise
+    # interchangeable (pinned by tests/test_mesh2d.py).
+
+    def _margins_value_grad_2d(self, coef: Array, l2
+                               ) -> Tuple[List[Array], Array, Array]:
+        z_list: List[Array] = []
+        sfold = self._new_fold()
+        colfold = _ColFold(self)
+        with span("accumulate"):
+            eff, shift = self._norm_prep(coef)
+            wrc = self._put_col_slices(eff)
+            for e in self.cache.blocks():
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    z_lin = self._chain_margins(r, e.cols, wrc[r])
+                    z, val, u, su = self._row_kits[r]["finish"](
+                        z_lin, e.labels, e.offsets, e.weights, shift,
+                        n=e.n_rows)
+                    self._rmv_cols(r, e.cols, u, colfold)
+                z_list.append(z)
+                sfold.add(e.slot, (val, su))
+            val, su = sfold.result()
+            g_raw = colfold.result_host()
+        val, su = self._decommit(val), self._decommit(su)
+        f = val + 0.5 * l2 * jnp.vdot(coef, coef)
+        return z_list, f, self._finish_grad(jnp.asarray(g_raw), su,
+                                            coef, l2)
+
+    def _margin_direction_list_2d(self, direction: Array) -> List[Array]:
+        out: List[Array] = []
+        with span("accumulate"):
+            eff, shift = self._norm_prep(direction)
+            wrc = self._put_col_slices(eff)
+            for e in self.cache.blocks():
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    zp_lin = self._chain_margins(r, e.cols, wrc[r])
+                    out.append(self._row_kits[r]["dirfin"](
+                        zp_lin, e.offsets, shift))
+        return out
+
+    def _grad_from_margins_list_2d(self, coef: Array,
+                                   z_list: Sequence[Array], l2) -> Array:
+        sfold = self._new_fold()
+        colfold = _ColFold(self)
+        with span("accumulate"):
+            for e, z in zip(self.cache.blocks(), z_list):
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    u, su = self._row_kits[r]["uz"](
+                        z, e.labels, e.weights, n=e.n_rows)
+                    self._rmv_cols(r, e.cols, u, colfold)
+                sfold.add(e.slot, su)
+            su = sfold.result()
+            g_raw = colfold.result_host()
+        return self._finish_grad(jnp.asarray(g_raw), self._decommit(su),
+                                 coef, l2)
+
+    def _hessian_vector_2d(self, vec: Array, d2_list: Sequence[Array],
+                           l2) -> Array:
+        sfold = self._new_fold()
+        colfold = _ColFold(self)
+        with span("accumulate"):
+            eff, shift = self._norm_prep(vec)
+            wrc = self._put_col_slices(eff)
+            for e, d2 in zip(self.cache.blocks(), d2_list):
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    zp_lin = self._chain_margins(r, e.cols, wrc[r])
+                    t, su = self._row_kits[r]["hmid"](
+                        zp_lin, e.offsets, shift, d2, n=e.n_rows)
+                    self._rmv_cols(r, e.cols, t, colfold)
+                sfold.add(e.slot, su)
+            su = sfold.result()
+            r_raw = colfold.result_host()
+        return self._finish_grad(jnp.asarray(r_raw), self._decommit(su),
+                                 vec, l2)
 
     # -- grid accumulation passes (batched λ-grid, PR 16) ------------------
     #
@@ -748,6 +1359,8 @@ class ShardedGLMObjective:
         margins, `[G]` objective values, `[G, d]` gradients."""
         self._ensure_grid_kits()
         _M_GRID_PASSES.inc()
+        if self.col_blocks > 1:
+            return self._grid_margins_value_grad_2d(coefs, l2s)
         z_list: List[Array] = []
         fold = self._new_fold(grid=True)
         with span("accumulate"):
@@ -769,6 +1382,8 @@ class ShardedGLMObjective:
         directions — one feature pass for the whole grid."""
         self._ensure_grid_kits()
         _M_GRID_PASSES.inc()
+        if self.col_blocks > 1:
+            return self._grid_margin_direction_list_2d(directions)
         out: List[Array] = []
         with span("accumulate"):
             ds = self._per_device(directions)
@@ -816,6 +1431,9 @@ class ShardedGLMObjective:
         rmatvec feature pass for the whole grid."""
         self._ensure_grid_kits()
         _M_GRID_PASSES.inc()
+        if self.col_blocks > 1:
+            return self._grid_grad_from_margins_list_2d(
+                coefs, z_list, l2s)
         fold = self._new_fold(grid=True)
         with span("accumulate"):
             for e, z in zip(self.cache.blocks(), z_list):
@@ -840,6 +1458,8 @@ class ShardedGLMObjective:
         serves every grid row's CG iterate."""
         self._ensure_grid_kits()
         _M_GRID_PASSES.inc()
+        if self.col_blocks > 1:
+            return self._grid_hessian_vector_2d(vecs, d2_list, l2s)
         fold = self._new_fold(grid=True)
         with span("accumulate"):
             vs = self._per_device(vecs)
@@ -852,6 +1472,100 @@ class ShardedGLMObjective:
                 fold.add(e.slot, part)
             r_raw, su = fold.result()
         return self._grid_finish_grad(r_raw, su, vecs, l2s)
+
+    # -- 2-D grid passes (batched λ-grid x model axis) ---------------------
+    #
+    # The grid axis vmaps PER COLUMN-BLOCK kernel: coefficient PANELS
+    # [G, block_size] broadcast per mesh coordinate, the margin chain
+    # hops [G, rows] accumulators along each data row, and the
+    # model-axis concat yields [G, d] on the host — one decode+H2D
+    # feature pass still serves every grid point AND every column block.
+
+    def _grid_margins_value_grad_2d(
+            self, coefs: Array, l2s: Array
+    ) -> Tuple[List[Array], Array, Array]:
+        z_list: List[Array] = []
+        sfold = self._new_fold(grid=True)
+        colfold = _ColFold(self, grid=True)
+        with span("accumulate"):
+            eff, shift = self._grid_norm_prep(coefs)
+            wrc = self._put_col_slices(eff)
+            for e in self.cache.blocks():
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    z_lin = self._chain_margins(r, e.cols, wrc[r],
+                                                grid=True)
+                    z, val, u, su = self._grid_row_kits[r]["finish"](
+                        z_lin, e.labels, e.offsets, e.weights, shift,
+                        n=e.n_rows)
+                    self._rmv_cols(r, e.cols, u, colfold, grid=True)
+                z_list.append(z)
+                sfold.add(e.slot, (val, su))
+            val, su = sfold.result()
+            g_raw = colfold.result_host()
+        val, su = self._decommit(val), self._decommit(su)
+        f = val + 0.5 * l2s * jnp.sum(coefs * coefs, axis=-1)
+        return z_list, f, self._grid_finish_grad(jnp.asarray(g_raw), su,
+                                                 coefs, l2s)
+
+    def _grid_margin_direction_list_2d(self, directions: Array
+                                       ) -> List[Array]:
+        out: List[Array] = []
+        with span("accumulate"):
+            eff, shift = self._grid_norm_prep(directions)
+            wrc = self._put_col_slices(eff)
+            for e in self.cache.blocks():
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    zp_lin = self._chain_margins(r, e.cols, wrc[r],
+                                                 grid=True)
+                    out.append(self._grid_row_kits[r]["dirfin"](
+                        zp_lin, e.offsets, shift))
+        return out
+
+    def _grid_grad_from_margins_list_2d(self, coefs: Array,
+                                        z_list: Sequence[Array],
+                                        l2s: Array) -> Array:
+        sfold = self._new_fold(grid=True)
+        colfold = _ColFold(self, grid=True)
+        with span("accumulate"):
+            for e, z in zip(self.cache.blocks(), z_list):
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    u, su = self._grid_row_kits[r]["uz"](
+                        z, e.labels, e.weights, n=e.n_rows)
+                    self._rmv_cols(r, e.cols, u, colfold, grid=True)
+                sfold.add(e.slot, su)
+            su = sfold.result()
+            g_raw = colfold.result_host()
+        return self._grid_finish_grad(jnp.asarray(g_raw),
+                                      self._decommit(su), coefs, l2s)
+
+    def _grid_hessian_vector_2d(self, vecs: Array,
+                                d2_list: Sequence[Array],
+                                l2s: Array) -> Array:
+        sfold = self._new_fold(grid=True)
+        colfold = _ColFold(self, grid=True)
+        with span("accumulate"):
+            eff, shift = self._grid_norm_prep(vecs)
+            wrc = self._put_col_slices(eff)
+            for e, d2 in zip(self.cache.blocks(), d2_list):
+                self._require_restored(e)
+                r = e.slot // self.col_blocks
+                with self._dev_span(e.slot):
+                    zp_lin = self._chain_margins(r, e.cols, wrc[r],
+                                                 grid=True)
+                    t, su = self._grid_row_kits[r]["hmid"](
+                        zp_lin, e.offsets, shift, d2, n=e.n_rows)
+                    self._rmv_cols(r, e.cols, t, colfold, grid=True)
+                sfold.add(e.slot, su)
+            su = sfold.result()
+            r_raw = colfold.result_host()
+        return self._grid_finish_grad(jnp.asarray(r_raw),
+                                      self._decommit(su), vecs, l2s)
 
     def grid_row_margins(self, z_list: Sequence[Array],
                          row: int) -> List[Array]:

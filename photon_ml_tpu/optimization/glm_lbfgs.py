@@ -312,6 +312,19 @@ def minimize_lbfgs_glm_streaming(
     runs on the fold device. With the default "ordered" combine the
     solve result is bit-identical for every device count.
 
+    2-D (data x model) meshes compose the same way, with one DOCUMENTED
+    state decision: the host-side convergence state — coefficients,
+    gradient, L-BFGS curvature history, direction — STAYS FULL-WIDTH on
+    the host/default device (it is NOT blocked over the model axis).
+    The sharded objective hands this solver full-width [d] gradients
+    assembled by its deterministic model-axis concat and takes
+    full-width coefficients back, slicing them per column block before
+    anything reaches a mesh device — so the solver needs no code for
+    the model axis at all, and mesh shapes {1x1, 2x1, 1x2, 2x2} solve
+    bit-identically (ops/sharded_objective.py module docstring; O(d)
+    host memory for solver state is the accepted cost, blocked solver
+    state is the ROADMAP follow-on).
+
     Spill-tier interaction: the margin cache (z per shard) and the
     line-search trials live in ROW space, which the cache never evicts
     — so `trial_values` and `update_margins` walk `cache.entries`

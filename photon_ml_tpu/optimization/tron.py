@@ -379,6 +379,13 @@ def minimize_tron_streaming(
     stays resident on each shard's mesh device, each CG step broadcasts
     the direction and folds the Hvp partials in fixed shard order, while
     the [d]-space trust-region algebra here runs on the fold device.
+    On a 2-D (data x model) mesh the CG direction broadcasts as
+    per-column-block SLICES and Hvp partials re-assemble through the
+    objective's deterministic model-axis concat; the trust-region state
+    here (coefficients, gradient, CG iterates) stays FULL-WIDTH on the
+    host/default device — the documented state decision shared with
+    `minimize_lbfgs_glm_streaming` — so mesh shapes {1x1, 2x1, 1x2,
+    2x2} solve bit-identically with no TRON-side mesh code.
 
     Spill-tier interaction: margins and curvature (the per-outer-
     iteration row-space state) are never evicted, so the compressed

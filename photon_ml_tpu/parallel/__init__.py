@@ -10,7 +10,10 @@ from photon_ml_tpu.parallel.distributed import (
     make_mesh,
     make_mesh_2d,
     mesh_device_list,
+    mesh_fold_devices,
+    mesh_grid_2d,
     replicate,
+    split_csr_columns,
     shard_batch,
     shard_batch_csr_feature_dim,
     shard_batch_feature_dim,
@@ -25,7 +28,10 @@ __all__ = [
     "make_mesh",
     "make_mesh_2d",
     "mesh_device_list",
+    "mesh_fold_devices",
+    "mesh_grid_2d",
     "replicate",
+    "split_csr_columns",
     "shard_batch",
     "shard_batch_csr_feature_dim",
     "shard_batch_feature_dim",

@@ -89,6 +89,63 @@ def make_mesh_2d(num_data: int, num_model: int,
     return Mesh(grid, (data_axis, model_axis))
 
 
+def mesh_grid_2d(mesh: Mesh) -> tuple:
+    """``(R, C, grid)`` of a 1-D or 2-D mesh: ``R`` data-axis devices,
+    ``C`` model-axis devices, ``grid`` the row-major ``[R][C]`` device
+    lists. A 1-D mesh is the ``C = 1`` column — the streamed fold's
+    round-robin data axis with no coefficient sharding. This is the one
+    mesh-shape accessor of the 2-D streamed objective
+    (ops/sharded_objective.py): cache shard ``i``'s column block ``c``
+    lives on ``grid[i % R][c]`` and the flat row-major order
+    (:func:`mesh_fold_devices`) is the cache's ``devices=`` list."""
+    arr = np.asarray(mesh.devices)
+    if arr.ndim == 1:
+        return int(arr.shape[0]), 1, [[d] for d in arr.flat]
+    if arr.ndim != 2:
+        raise ValueError(
+            f"expected a 1-D or 2-D mesh, got axes {tuple(mesh.shape)}")
+    return (int(arr.shape[0]), int(arr.shape[1]),
+            [list(row) for row in arr])
+
+
+def mesh_fold_devices(mesh: Mesh) -> list:
+    """Flat ROW-MAJOR device list of a 1-D or 2-D (data, model) mesh —
+    the ``devices=`` placement list for `DeviceShardCache`: slot
+    ``(i % R) * C + c`` holds shard ``i``'s column block ``c``. For a
+    1-D mesh this is exactly :func:`mesh_device_list`."""
+    r, c, grid = mesh_grid_2d(mesh)
+    return [d for row in grid for d in row]
+
+
+def split_csr_columns(mat, num_blocks: int) -> tuple:
+    """Host-side twin of :func:`shard_batch_csr_feature_dim`'s column
+    routing for a scipy CSR matrix: ``(block_size, [sub_0..sub_{C-1}])``
+    where ``block_size = ceil(d / num_blocks)`` (the
+    `blocked_csr_from_scipy` rule — ``owner = col // block_size``) and
+    ``sub_c`` is the canonical CSR slice ``mat[:, c*bs:(c+1)*bs]`` with
+    LOCAL column ids. Scipy column slicing preserves canonical (row-
+    major, column-ascending) entry order, so each block's nnz stream is
+    an order-preserving subsequence of the full stream — the property
+    that makes the streamed objective's chained per-block scatters
+    bitwise-reproduce the unblocked contraction
+    (ops/sharded_objective.py module docstring)."""
+    import scipy.sparse as sp
+
+    if num_blocks < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+    mat = sp.csr_matrix(mat)
+    d = mat.shape[1]
+    block = -(-d // num_blocks)
+    subs = []
+    for c in range(num_blocks):
+        lo = min(c * block, d)
+        hi = min(lo + block, d)
+        sub = mat[:, lo:hi].tocsr()
+        sub.sort_indices()
+        subs.append(sub)
+    return block, subs
+
+
 def _pad_to_multiple(a: np.ndarray | Array, k: int, axis: int,
                      fill) -> Array:
     n = a.shape[axis]

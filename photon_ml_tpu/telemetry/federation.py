@@ -109,6 +109,11 @@ GAUGE_MERGE_POLICIES: Dict[str, str] = {
     # Batched λ-grid: in-flight grid points sum across processes (the
     # fleet-wide count of λ points still iterating).
     "training.grid.active_points": "sum",
+    # 2-D mesh extents (ops/sharded_objective.py): each process trains
+    # on its own mesh; the fleet view keeps the newest writer rather
+    # than summing axis extents into a meaningless total. (The
+    # training.mesh.*_transfer_bytes series are counters and sum.)
+    "training.mesh.": "last",
 }
 
 _VALID_POLICIES = ("sum", "max", "last")

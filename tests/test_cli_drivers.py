@@ -968,9 +968,10 @@ def test_stream_train_mf_schema_grid_and_compile_bounds(tmp_path, rng):
     assert summary["numCombos"] == 3
     info = summary["stream_train"]
     assert set(info) == {"mode", "batch_rows", "hbm_budget_bytes",
-                         "mesh_devices", "spill_dtype", "spill_source",
-                         "feeder", "cache", "plan", "trace_budgets",
-                         "trace_counts", "cache_by_num_factors"}
+                         "mesh_devices", "mesh_shape", "spill_dtype",
+                         "spill_source", "feeder", "cache", "plan",
+                         "trace_budgets", "trace_counts",
+                         "cache_by_num_factors"}
     # every factor cache in a multi-k grid stays observable post-run
     assert set(info["cache_by_num_factors"]) == {"2", "3"}
     assert info["cache_by_num_factors"]["3"] == info["cache"]
@@ -1253,9 +1254,9 @@ def test_stream_train_snake_schema_and_trace(tmp_path, rng):
 
     info = summary["stream_train"]
     assert set(info) == {"mode", "batch_rows", "hbm_budget_bytes",
-                         "mesh_devices", "spill_dtype", "spill_source",
-                         "feeder", "cache", "grid_batched", "grid_points",
-                         "trace_budgets", "trace_counts"}
+                         "mesh_devices", "mesh_shape", "spill_dtype",
+                         "spill_source", "feeder", "cache", "grid_batched",
+                         "grid_points", "trace_budgets", "trace_counts"}
     assert info["batch_rows"] == 32
     assert info["mode"] == "spill"
     assert info["mesh_devices"] is None

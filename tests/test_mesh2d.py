@@ -1,0 +1,611 @@
+"""2-D (data x model) mesh: the coefficient dimension sharded into the
+cached streamed solve (ops/sharded_objective.py ``col_blocks > 1``,
+data/shard_cache.py ``col_blocks=``, parallel/distributed.py
+``make_mesh_2d``/``split_csr_columns``).
+
+The PR-19 contract extends the PR-15 device-count invariance to a
+second axis: with the default "ordered" combine, every fold quantity
+and every streamed solve is BIT-IDENTICAL across mesh shapes {none,
+1x1, 2x1, 1x2, 2x2} — the data axis reuses the ordered left-fold, the
+model axis chains per-column-block scatter-adds whose nnz streams are
+order-preserving subsequences of the full stream (split_csr_columns
+docstring), so the blocked contraction reassociates NOTHING.
+
+One measured exception (module docstring of sharded_objective):
+SHIFTS-normalization moves the ``-(eff @ shifts)`` dot into a
+standalone prep kernel whose reduction may differ from the fused
+per-shard kernels by ~1 ulp; factors-only normalization stays exactly
+bitwise. The gates below mirror that: bitwise for none/factors,
+allclose for shifts.
+
+The subprocess tests drive the REAL total-device-count axis for
+--mesh-shape RxC and its composition with --grid-batched.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.data.shard_cache import DeviceShardCache
+from photon_ml_tpu.ops.glm_objective import GLMObjective
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.sharded_objective import ShardedGLMObjective
+from photon_ml_tpu.optimization.glm_lbfgs import (
+    minimize_lbfgs_glm_streaming,
+)
+from photon_ml_tpu.optimization.tron import minimize_tron_streaming
+from photon_ml_tpu.parallel import (
+    make_mesh_2d,
+    mesh_fold_devices,
+    mesh_grid_2d,
+    split_csr_columns,
+)
+from photon_ml_tpu.types import TaskType
+
+from tests.test_shard_cache import FakeStream
+
+SHAPES = (None, (1, 1), (2, 1), (1, 2), (2, 2))
+
+
+@pytest.fixture
+def problem(rng):
+    n, d = 1003, 41
+    X = sp.random(n, d, density=0.1, random_state=19, format="csr")
+    X.data[:] = rng.normal(0, 1, X.nnz)
+    y = (rng.random(n) < 0.5).astype(float)
+    off = rng.normal(0, 0.1, n)
+    w = rng.gamma(1.0, 1.0, n)
+    return X, y, off, w
+
+
+def _bits(x):
+    return np.asarray(x).tobytes()
+
+
+def _norm(problem, mode):
+    d = problem[0].shape[1]
+    if mode is None:
+        return None
+    factors = jnp.asarray(
+        np.linspace(0.5, 1.5, d).astype(np.float32))
+    if mode == "factors":
+        return NormalizationContext(factors, None, d - 1)
+    shifts = jnp.asarray(
+        np.linspace(-0.2, 0.3, d).astype(np.float32)
+    ).at[d - 1].set(0.0)
+    return NormalizationContext(factors, shifts, d - 1)
+
+
+def _sobj2d(problem, shape=None, budget=None, batch_rows=128,
+            combine="ordered", norm=None, prefetch_depth=None):
+    """Build a sharded objective on a 2-D mesh of ``shape`` (R, C);
+    shape=None is the non-mesh fold."""
+    X, y, off, w = problem
+    mesh = None
+    devices = None
+    col_blocks = 1
+    if shape is not None:
+        r, c = shape
+        mesh = make_mesh_2d(r, c)
+        if r * c > 1:
+            devices = mesh_fold_devices(mesh)
+        col_blocks = c
+    cache = DeviceShardCache.from_stream(
+        FakeStream(X, y, batch_rows, off, w), "g",
+        hbm_budget_bytes=budget, devices=devices,
+        col_blocks=col_blocks)
+    if prefetch_depth is not None:
+        cache.prefetch_depth = prefetch_depth
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION),
+                       normalization=norm)
+    return ShardedGLMObjective(obj, cache, mesh=mesh, combine=combine)
+
+
+# -- split_csr_columns: the host-side column routing -----------------------
+
+
+def test_split_csr_columns_reassembly_identity(rng):
+    """hstack of the column blocks (local ids back to global) is the
+    original matrix exactly — nothing is dropped, nothing moves."""
+    n, d = 57, 23
+    mat = sp.random(n, d, density=0.3, random_state=7, format="csr")
+    mat.data[:] = rng.normal(0, 1, mat.nnz)
+    for num_blocks in (1, 2, 3, 5, 23, 40):
+        bs, subs = split_csr_columns(mat, num_blocks)
+        assert bs == -(-d // num_blocks)
+        assert len(subs) == num_blocks
+        back = sp.hstack(subs).tocsr()
+        assert back.shape == mat.shape
+        assert (back != mat).nnz == 0
+        # per-block values are an order-preserving subsequence of the
+        # full stream: concatenating the blocks' data in block order
+        # permutes rows but each block's entries keep csr order
+        for c, sub in enumerate(subs):
+            lo = c * bs
+            ref = mat[:, lo:lo + sub.shape[1]].tocsr()
+            ref.sort_indices()
+            np.testing.assert_array_equal(sub.data, ref.data)
+            np.testing.assert_array_equal(sub.indices, ref.indices)
+
+
+def test_split_csr_columns_block_boundary_nnz():
+    """Entries at the exact block boundaries route to the right owner
+    (owner = col // block_size) with LOCAL column ids."""
+    n, d = 4, 8  # 2 blocks of width 4: boundary cols 3 | 4
+    rows = [0, 1, 2, 3, 0]
+    cols = [3, 4, 0, 7, 4]
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    mat = sp.coo_matrix((vals, (rows, cols)), shape=(n, d)).tocsr()
+    bs, (b0, b1) = split_csr_columns(mat, 2)
+    assert bs == 4
+    assert b0.nnz == 2 and b1.nnz == 3
+    assert set(zip(*b0.nonzero())) == {(0, 3), (2, 0)}
+    # global cols 4 and 7 become local 0 and 3 in block 1
+    assert set(zip(*b1.nonzero())) == {(0, 0), (1, 0), (3, 3)}
+
+
+def test_split_csr_columns_empty_block(rng):
+    """A column block with no nnz is still a correctly-shaped empty
+    CSR slice (the chained scatter adds nothing — identity hop)."""
+    n, d = 11, 9
+    mat = sp.random(n, 3, density=0.5, random_state=5, format="csr")
+    mat.resize((n, d))  # cols 3.. are all-zero
+    bs, subs = split_csr_columns(mat.tocsr(), 3)
+    assert bs == 3
+    assert subs[0].nnz > 0
+    assert subs[1].nnz == 0 and subs[2].nnz == 0
+    assert subs[1].shape == (n, 3) and subs[2].shape == (n, 3)
+    back = sp.hstack(subs).tocsr()
+    assert (back != mat.tocsr()).nnz == 0
+
+
+def test_split_csr_columns_validation():
+    mat = sp.random(5, 5, density=0.5, random_state=1, format="csr")
+    with pytest.raises(ValueError, match="num_blocks"):
+        split_csr_columns(mat, 0)
+
+
+def test_csr_feature_dim_sharding_block_mismatch(rng):
+    """shard_batch_csr_feature_dim rejects features pre-blocked for a
+    different device count (rebuild, don't silently re-route)."""
+    from photon_ml_tpu.ops.features import blocked_csr_from_scipy
+    from photon_ml_tpu.ops.glm_objective import GLMBatch
+    from photon_ml_tpu.parallel import shard_batch_csr_feature_dim
+    from photon_ml_tpu.parallel.distributed import make_mesh
+
+    n, d = 20, 8
+    mat = sp.random(n, d, density=0.5, random_state=3, format="csr")
+    feats = blocked_csr_from_scipy(mat, 4, dtype=jnp.float32)
+    batch = GLMBatch(
+        features=feats,
+        labels=jnp.zeros(n, jnp.float32),
+        offsets=jnp.zeros(n, jnp.float32),
+        weights=jnp.ones(n, jnp.float32))
+    with pytest.raises(ValueError, match="column blocks"):
+        shard_batch_csr_feature_dim(batch, make_mesh(2))
+
+
+def test_mesh_grid_2d_shapes():
+    """mesh_grid_2d: (R, C, row-major device grid); 1-D meshes read as
+    (N, 1)."""
+    from photon_ml_tpu.parallel.distributed import make_mesh
+
+    r, c, grid = mesh_grid_2d(make_mesh_2d(2, 2))
+    assert (r, c) == (2, 2)
+    assert len(grid) == 2 and all(len(row) == 2 for row in grid)
+    flat = [d for row in grid for d in row]
+    assert flat == mesh_fold_devices(make_mesh_2d(2, 2))
+    r, c, grid = mesh_grid_2d(make_mesh(3))
+    assert (r, c) == (3, 1)
+
+
+# -- the bitwise gate across mesh shapes -----------------------------------
+
+
+def test_2d_value_grad_hvp_bitwise_across_shapes(problem, rng):
+    """Acceptance: every fold quantity is bit-identical for mesh shapes
+    {1x1, 2x1, 1x2, 2x2} and equal to the non-mesh fold."""
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    vec = jnp.asarray(rng.normal(0, 1.0, X.shape[1]), jnp.float32)
+    l2 = jnp.asarray(0.7, jnp.float32)
+
+    ref = _sobj2d(problem)
+    z_ref, f_ref, g_ref = ref.margins_value_grad(coef, l2)
+    hv_ref = ref.hessian_vector(vec, ref.curvature_list(z_ref), l2)
+    dir_ref = ref.margin_direction_list(vec)
+    for shape in SHAPES[1:]:
+        s = _sobj2d(problem, shape=shape)
+        z, f, g = s.margins_value_grad(coef, l2)
+        assert _bits(f) == _bits(f_ref), shape
+        assert _bits(g) == _bits(g_ref), shape
+        for za, zb in zip(z, z_ref):
+            assert _bits(za) == _bits(zb), shape
+        hv = s.hessian_vector(vec, s.curvature_list(z), l2)
+        assert _bits(hv) == _bits(hv_ref), shape
+        for da, db in zip(s.margin_direction_list(vec), dir_ref):
+            assert _bits(da) == _bits(db), shape
+        g2 = s.grad_from_margins_list(coef, z, l2)
+        assert _bits(g2) == _bits(
+            ref.grad_from_margins_list(coef, z_ref, l2)), shape
+
+
+def test_2d_normalized_passes(problem, rng):
+    """Factors-only normalization stays exactly bitwise across shapes;
+    SHIFTS-normalization is allclose (the documented ~1-ulp margin-
+    shift reassociation — sharded_objective module docstring)."""
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    l2 = jnp.asarray(0.7, jnp.float32)
+    for mode, exact in (("factors", True), ("shifts", False)):
+        norm = _norm(problem, mode)
+        ref = _sobj2d(problem, norm=norm)
+        _, f_ref, g_ref = ref.margins_value_grad(coef, l2)
+        for shape in ((1, 2), (2, 2)):
+            s = _sobj2d(problem, shape=shape, norm=norm)
+            _, f, g = s.margins_value_grad(coef, l2)
+            if exact:
+                assert _bits(f) == _bits(f_ref), (mode, shape)
+                assert _bits(g) == _bits(g_ref), (mode, shape)
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(f), np.asarray(f_ref), rtol=1e-6)
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(g_ref),
+                    rtol=1e-5, atol=1e-6)
+
+
+def test_2d_solves_bitwise_across_shapes(problem):
+    """Full streamed L-BFGS and TRON solves are bit-identical across
+    mesh shapes (plain and factors-only normalization)."""
+    X = problem[0]
+    d = X.shape[1]
+    for norm in (None, _norm(problem, "factors")):
+        ref = _sobj2d(problem, norm=norm)
+        lb_ref = minimize_lbfgs_glm_streaming(
+            ref, jnp.zeros(d, jnp.float32), 0.5, max_iter=12)
+        tr_ref = minimize_tron_streaming(
+            ref, jnp.zeros(d, jnp.float32), 0.5, max_iter=4)
+        for shape in ((2, 1), (1, 2), (2, 2)):
+            s = _sobj2d(problem, shape=shape, norm=norm)
+            lb = minimize_lbfgs_glm_streaming(
+                s, jnp.zeros(d, jnp.float32), 0.5, max_iter=12)
+            assert _bits(lb.x) == _bits(lb_ref.x), shape
+            assert _bits(lb.value) == _bits(lb_ref.value), shape
+            tr = minimize_tron_streaming(
+                s, jnp.zeros(d, jnp.float32), 0.5, max_iter=4)
+            assert _bits(tr.x) == _bits(tr_ref.x), shape
+
+
+def test_2d_residency_independence(problem, rng):
+    """Budget-forced eviction under a 2x2 mesh reproduces the resident
+    2x2 fold bit for bit (the budget binds per (row, col) unit; misses
+    restore per-column slices)."""
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    l2 = jnp.asarray(0.7, jnp.float32)
+    resident = _sobj2d(problem, shape=(2, 2))
+    _, f_ref, g_ref = resident.margins_value_grad(coef, l2)
+    block = max(e.feature_bytes for e in resident.cache.entries)
+    for budget, depth in ((block + 1, None), (block + 1, 0)):
+        s = _sobj2d(problem, shape=(2, 2), budget=budget,
+                    prefetch_depth=depth)
+        _, f, g = s.margins_value_grad(coef, l2)
+        assert s.cache.stats()["evictions"] > 0
+        assert _bits(f) == _bits(f_ref)
+        assert _bits(g) == _bits(g_ref)
+
+
+def test_2d_trace_budgets(problem, rng):
+    """Compile counts stay within the per-coordinate budgets for 2-D
+    shapes, and adding data-axis devices never buys a column kernel
+    more compiles (flat per axis)."""
+    X = problem[0]
+    d = X.shape[1]
+    coef = jnp.asarray(rng.normal(0, 0.3, d), jnp.float32)
+    counts = {}
+    for shape in ((1, 2), (2, 2), (4, 2)):
+        s = _sobj2d(problem, shape=shape)
+        z, _, _ = s.margins_value_grad(coef, 0.5)
+        s.hessian_vector(coef, s.curvature_list(z), 0.5)
+        minimize_lbfgs_glm_streaming(
+            s, jnp.zeros(d, jnp.float32), 0.5, max_iter=6)
+        s.assert_trace_budget()
+        counts[shape] = s.guard.counts()
+        budgets = s.trace_budgets()
+        assert any(k.startswith("sharded:mv0@") for k in budgets)
+        assert "sharded:col_combine@c0" in budgets
+    # per-column combine compiles are identical no matter the data extent
+    for key in ("sharded:col_combine@c0", "sharded:col_combine@c1"):
+        per_shape = {counts[sh].get(key, 0) for sh in counts}
+        assert len(per_shape) == 1, (key, counts)
+
+
+def test_2d_validation_errors(problem):
+    """Mis-wiring fails loudly: cache blocked for a different model
+    extent, and the 'local' combine with a model axis."""
+    X, y, off, w = problem
+    obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
+    mesh = make_mesh_2d(2, 2)
+    devices = mesh_fold_devices(mesh)
+    cache1 = DeviceShardCache.from_stream(
+        FakeStream(X, y, 200, off, w), "g", devices=devices,
+        col_blocks=1)
+    with pytest.raises(ValueError, match="col_blocks"):
+        ShardedGLMObjective(obj, cache1, mesh=mesh)
+    cache2 = DeviceShardCache.from_stream(
+        FakeStream(X, y, 200, off, w), "g", devices=devices,
+        col_blocks=2)
+    with pytest.raises(ValueError, match="model axis"):
+        ShardedGLMObjective(obj, cache2, mesh=mesh, combine="local")
+
+
+def test_2d_telemetry_spans_and_metrics(problem, rng):
+    """The model axis emits its own span families (col_block_fold:cK
+    per chained scatter hop, model_axis_concat at the apex) and the
+    training.mesh.* extent gauges / transfer counters."""
+    from photon_ml_tpu import telemetry
+
+    X = problem[0]
+    coef = jnp.asarray(rng.normal(0, 0.3, X.shape[1]), jnp.float32)
+    telemetry.reset()
+    telemetry.enable(trace=True)
+    try:
+        s = _sobj2d(problem, shape=(1, 2))
+        s.margins_value_grad(coef, 0.5)
+        att = telemetry.stage_attribution()
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert "col_block_fold:c0" in att and "col_block_fold:c1" in att
+    assert "model_axis_concat" in att
+    assert "cross_device_combine" in att
+    g = snap["gauges"]
+    assert g["training.mesh.data_axis_devices"] == 1
+    assert g["training.mesh.model_axis_devices"] == 2
+    assert snap["counters"]["training.mesh.model_axis_transfer_bytes"] > 0
+
+
+def test_2d_grid_passes_bitwise(problem, rng):
+    """The batched λ-grid twins reproduce the 1x1 grid fold bit for bit
+    on a 2x2 mesh (G=3)."""
+    X = problem[0]
+    d = X.shape[1]
+    G = 3
+    coefs = jnp.asarray(rng.normal(0, 0.3, (G, d)), jnp.float32)
+    vecs = jnp.asarray(rng.normal(0, 1.0, (G, d)), jnp.float32)
+    l2s = jnp.asarray([0.1, 0.7, 5.0], jnp.float32)
+    for norm in (None, _norm(problem, "factors")):
+        ref = _sobj2d(problem, norm=norm)
+        z_ref, f_ref, g_ref = ref.grid_margins_value_grad(coefs, l2s)
+        hv_ref = ref.grid_hessian_vector(
+            vecs, ref.grid_curvature_list(z_ref), l2s)
+        s = _sobj2d(problem, shape=(2, 2), norm=norm)
+        z, f, g = s.grid_margins_value_grad(coefs, l2s)
+        assert _bits(f) == _bits(f_ref)
+        assert _bits(g) == _bits(g_ref)
+        for za, zb in zip(z, z_ref):
+            assert _bits(za) == _bits(zb)
+        hv = s.grid_hessian_vector(vecs, s.grid_curvature_list(z), l2s)
+        assert _bits(hv) == _bits(hv_ref)
+
+
+def test_2d_streaming_coordinate_solve(problem):
+    """StreamingFixedEffectCoordinate on a 2-D mesh writes the same
+    coefficient bits as the non-mesh coordinate."""
+    from photon_ml_tpu.algorithm.coordinates import (
+        StreamingFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+    )
+
+    X, y, off, w = problem
+    cfg = GLMOptimizationConfiguration.parse("5,1e-6,1.0,1.0,LBFGS,L2")
+
+    def solve(mesh, devices, col_blocks):
+        cache = DeviceShardCache.from_stream(
+            FakeStream(X, y, 200, off, w), "g", devices=devices,
+            col_blocks=col_blocks)
+        coord = StreamingFixedEffectCoordinate(
+            name="fe", cache=cache, feature_shard_id="g",
+            task_type=TaskType.LOGISTIC_REGRESSION, config=cfg,
+            mesh=mesh)
+        model, result = coord.solve()
+        assert int(result.iterations) > 0
+        return np.asarray(model.glm.coefficients.means)
+
+    ref = solve(None, None, 1)
+    mesh = make_mesh_2d(2, 2)
+    got = solve(mesh, mesh_fold_devices(mesh), 2)
+    assert ref.shape == (X.shape[1],)
+    assert _bits(got) == _bits(ref)
+
+
+# -- factor cache model-axis placement (satellite) -------------------------
+
+
+def test_factor_cache_device_placement(rng):
+    """DeviceFactorCache devices=: shard i lives on devices[i % D],
+    restores land back on the home device, and the devices=None path
+    returns byte-identical tables to the placed one."""
+    from photon_ml_tpu.data.factor_cache import (
+        DeviceFactorCache,
+        plan_factors,
+    )
+
+    vocab = np.asarray([f"e{i}" for i in range(24)])
+    counts = rng.integers(0, 9, size=24)
+    plan = plan_factors(vocab, counts, entities_per_shard=4)
+    k = 3
+    tables = [rng.normal(0, 1, (s.e_pad, k)).astype(np.float32)
+              for s in plan.shards]
+    devs = jax.devices()[:2]
+
+    placed = DeviceFactorCache(plan, k, devices=devs)
+    plain = DeviceFactorCache(plan, k)
+    for i, t in enumerate(tables):
+        a = placed.write(i, t)
+        b = plain.write(i, t)
+        assert _bits(a) == _bits(b), i
+        assert placed.shard_device(i) == devs[i % 2]
+        assert list(a.devices())[0] == devs[i % 2]
+    assert plain.shard_device(0) is None
+    assert placed.stats()["devices"] == 2 and \
+        plain.stats()["devices"] is None
+
+    # a budget-forced restore re-uploads onto the home device
+    one = plan.shards[0].e_pad * k * 4
+    tight = DeviceFactorCache(plan, k, hbm_budget_bytes=one + 1,
+                              devices=devs)
+    for i, t in enumerate(tables):
+        tight.write(i, t)
+    assert tight.stats()["evictions"] > 0
+    for i in range(len(tables)):
+        g = tight.ensure(i)
+        assert _bits(g) == _bits(plain.ensure(i)), i
+        assert list(g.devices())[0] == devs[i % 2], i
+
+
+# -- CLI: --mesh-shape ------------------------------------------------------
+
+
+def test_mesh_shape_flag_validation(tmp_path, rng):
+    """--mesh-shape parses RxC, excludes --mesh-devices, and inherits
+    the stream-train/hbm-budget composition rules."""
+    from photon_ml_tpu.cli import game_training_driver
+    from tests.test_cli_drivers import _STREAM_BASE, _write_sparse_fe_avro
+
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=60)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE
+    with pytest.raises(ValueError, match="one"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "a"), "--stream-train",
+                    "--hbm-budget", "8K", "--mesh-shape", "2x1",
+                    "--mesh-devices", "2"])
+    with pytest.raises(ValueError, match="--stream-train"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "b"),
+                    "--mesh-shape", "1x2"])
+    with pytest.raises(ValueError, match="--hbm-budget"):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "c"), "--stream-train",
+                    "--mesh-shape", "1x2"])
+    with pytest.raises(SystemExit):
+        game_training_driver.run(
+            base + ["--output-dir", str(tmp_path / "d"),
+                    "--mesh-shape", "2"])
+
+
+def test_mesh_shape_driver_model_identical(tmp_path, rng):
+    """In-process driver gate: --mesh-shape {1x1, 2x1, 1x2, 2x2} all
+    write the non-mesh spill model bit for bit, and --mesh-devices N
+    stays the back-compat alias of Nx1."""
+    from photon_ml_tpu.cli import game_training_driver
+    from tests.test_cli_drivers import (
+        _STREAM_BASE,
+        _coeff_records,
+        _write_sparse_fe_avro,
+    )
+
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=300)
+    base = ["--train-input-dirs", str(train)] + _STREAM_BASE + [
+        "--stream-train", "--batch-rows", "64", "--hbm-budget", "8K"]
+    game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "nomesh")])
+    ref = _coeff_records(tmp_path / "nomesh")
+    for shape in ("1x1", "2x1", "1x2", "2x2"):
+        out = tmp_path / f"mesh{shape}"
+        summary = game_training_driver.run(
+            base + ["--output-dir", str(out), "--mesh-shape", shape])
+        assert _coeff_records(out) == ref, shape
+        info = summary["stream_train"]
+        assert tuple(info["mesh_shape"]) == \
+            tuple(int(x) for x in shape.split("x"))
+        for name, count in info["trace_counts"].items():
+            assert count <= info["trace_budgets"][name], (shape, name)
+    alias = game_training_driver.run(
+        base + ["--output-dir", str(tmp_path / "alias"),
+                "--mesh-devices", "2"])
+    assert _coeff_records(tmp_path / "alias") == ref
+    assert tuple(alias["stream_train"]["mesh_shape"]) == (2, 1)
+
+
+_CHILD_GRID_MESH = """
+import hashlib
+import json
+from pathlib import Path
+
+import jax
+
+n_devices, shape, grid_cfg, out_dir, train_dir = (
+    __N__, __SHAPE__, __GRID__, __OUT__, __TRAIN__)
+assert jax.device_count() == n_devices
+
+from photon_ml_tpu.cli import game_training_driver
+from photon_ml_tpu.io.avro_codec import read_container
+
+summary = game_training_driver.run([
+    "--train-input-dirs", train_dir,
+    "--output-dir", out_dir,
+    "--task-type", "LOGISTIC_REGRESSION",
+    "--fixed-effect-data-configurations", "fixed:global",
+    "--fixed-effect-optimization-configurations", grid_cfg,
+    "--updating-sequence", "fixed",
+    "--stream-train", "--batch-rows", "48",
+    "--hbm-budget", "8K", "--mesh-shape", shape,
+    "--grid-batched", "auto",
+])
+info = summary["stream_train"]
+assert tuple(info["mesh_shape"]) == tuple(
+    int(x) for x in shape.split("x"))
+records = list(read_container(
+    Path(out_dir) / "best" / "fixed-effect" / "fixed" / "coefficients"
+    / "part-00000.avro"))
+print("COEFF_SHA", hashlib.sha256(
+    json.dumps(records, sort_keys=True).encode()).hexdigest())
+print("GRID_MESH_CHILD_OK", shape, info["grid_points"])
+"""
+
+_G1_CFG = "fixed:25,1e-7,1.0,1.0,LBFGS,L2"
+_G4_CFG = ("fixed:25,1e-7,0.5,1.0,LBFGS,L2|25,1e-7,1.0,1.0,LBFGS,L2"
+           "|25,1e-7,5.0,1.0,LBFGS,L2|25,1e-7,50.0,1.0,LBFGS,L2")
+
+
+def test_driver_grid_batched_2d_mesh_model_bytes(tmp_path, rng,
+                                                 multi_device):
+    """--grid-batched x 2-D mesh on the REAL device-count axis:
+    children whose jax sees exactly R*C devices run mesh shapes
+    {1x1, 2x2} for grids G in {1, 4}; within each G the decoded model
+    bytes must not depend on the mesh shape."""
+    from tests.test_cli_drivers import _write_sparse_fe_avro
+
+    train = tmp_path / "train"
+    _write_sparse_fe_avro(train, rng, n=150)
+    for g_tag, grid_cfg in (("g1", _G1_CFG), ("g4", _G4_CFG)):
+        shas = {}
+        for shape, n_dev in (("1x1", 1), ("2x2", 4)):
+            out = tmp_path / f"{g_tag}_{shape}"
+            code = (_CHILD_GRID_MESH
+                    .replace("__N__", str(n_dev))
+                    .replace("__SHAPE__", repr(shape))
+                    .replace("__GRID__", repr(grid_cfg))
+                    .replace("__OUT__", repr(str(out)))
+                    .replace("__TRAIN__", repr(str(train))))
+            proc = multi_device(n_dev, code, timeout=420)
+            assert f"GRID_MESH_CHILD_OK {shape}" in proc.stdout, \
+                proc.stdout
+            line = [ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("COEFF_SHA")][0]
+            shas[shape] = line.split()[1]
+        assert len(set(shas.values())) == 1, (g_tag, shas)
