@@ -92,6 +92,35 @@ _GAUGE_ONLY_FAMILIES = (
      "the serving.model.<label>.score_drift_* drift family"),
 )
 
+#: prefix-anchored COUNTER families (the inverse rule): under the
+#: prefix, registrations must be counters — the family counts wire
+#: events (requests, bytes, typed errors) and dashboards rate() the
+#: whole namespace — except gauges whose name ends with an allowlisted
+#: instantaneous-reading suffix. Histograms are never allowed (a wire
+#: latency distribution belongs under serving.frontend.*, where the
+#: SLO thresholds point). Prefix-anchored on fragments like the
+#: gauge-only prefix families (the serving.net.errors.<kind> f-string
+#: form starts with the literal prefix).
+_COUNTER_FAMILIES = (
+    ("serving.net.", ("open_connections",),
+     "the serving.net.* wire-event family"),
+)
+
+
+def _counter_family_violation(text: str, kind: str):
+    """The counter-family rule broken by ``text`` (a full literal name
+    or the leading fragment of a partially-dynamic one) under ``kind``,
+    if any: returns the family label."""
+    for prefix, gauge_suffixes, label in _COUNTER_FAMILIES:
+        if not text.startswith(prefix):
+            continue
+        if kind == "counter":
+            return None
+        if kind == "gauge" and text.endswith(tuple(gauge_suffixes)):
+            return None
+        return label
+    return None
+
 
 def _gauge_only_family(text: str, is_fragment: bool):
     """The gauge-only family ``text`` (a full literal name, or one
@@ -281,6 +310,15 @@ def check_file(path: Path, src: str, registrations: dict,
                         "readings refreshed on scrape "
                         "(docs/OBSERVABILITY.md §Distributions & "
                         "drift)"))
+                cfam = _counter_family_violation(name, kind)
+                if cfam is not None:
+                    out.append((
+                        path, node.lineno, "counter-family",
+                        f"{kind}({name!r}): {cfam} is counter-only "
+                        "(gauges only for allowlisted instantaneous "
+                        "readings, histograms never — wire latency "
+                        "belongs under serving.frontend.*) "
+                        "(docs/OBSERVABILITY.md §Network front door)"))
                 if (name.startswith("fleet.")
                         and not _is_federation_file(path)):
                     out.append((
@@ -330,6 +368,16 @@ def check_file(path: Path, src: str, registrations: dict,
                         "instantaneous readings refreshed on scrape "
                         "(docs/OBSERVABILITY.md §Distributions & "
                         "drift)"))
+                    break
+            for frag in frags:
+                cfam = _counter_family_violation(frag, kind)
+                if cfam is not None:
+                    out.append((
+                        path, node.lineno, "counter-family",
+                        f"{kind}(...{frag!r}...): {cfam} is "
+                        "counter-only (gauges only for allowlisted "
+                        "instantaneous readings, histograms never) "
+                        "(docs/OBSERVABILITY.md §Network front door)"))
                     break
             for frag in frags:
                 if (frag.startswith("fleet.")

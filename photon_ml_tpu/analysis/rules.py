@@ -491,12 +491,18 @@ class BlockingInAsyncRule:
            "loop for every in-flight request")
 
     #: Only the serving package hosts event-loop code; elsewhere a sync
-    #: sleep on a worker thread is legitimate pipeline behavior.
+    #: sleep on a worker thread is legitimate pipeline behavior. The
+    #: network front door grew event loops OUTSIDE serving/ — the
+    #: router CLI and the scoring driver's --listen mode run their own
+    #: asyncio loops — so those modules are covered file-wise.
     _DIRS = ("photon_ml_tpu/serving/",)
+    _FILES = ("photon_ml_tpu/cli/net_router.py",
+              "photon_ml_tpu/cli/game_scoring_driver.py")
 
     def check(self, mod: ModuleSource, project: Project) -> List[Violation]:
         p = "/" + mod.path
-        if not any("/" + d in p for d in self._DIRS):
+        if not (any("/" + d in p for d in self._DIRS)
+                or any(p.endswith("/" + f) for f in self._FILES)):
             return []
         out: List[Violation] = []
         for node in ast.walk(mod.tree):

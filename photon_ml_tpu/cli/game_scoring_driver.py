@@ -105,6 +105,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="--serve admission bound (requests admitted and "
                         "unfinished); raised to --serve-concurrency if "
                         "lower, so the closed-loop replay never sheds")
+    p.add_argument("--listen", default=None, metavar="ADDR",
+                   help="network serving mode (implies --serve): instead "
+                        "of replaying the input, open the protocol front "
+                        "door on ADDR (PORT, :PORT or HOST:PORT; port 0 "
+                        "= ephemeral, written to <output-dir>/net_port) "
+                        "speaking HTTP/1.1 JSON (POST /score) AND the "
+                        "length-prefixed binary framing on one port, "
+                        "both into the front-end's admission path "
+                        "(docs/SCALE.md §Serving network front door)")
+    p.add_argument("--serve-seconds", type=float, default=None,
+                   metavar="S",
+                   help="--listen lifetime: serve for S seconds, then "
+                        "drain and write the summary (default: until "
+                        "SIGINT; the drain still runs)")
+    p.add_argument("--adaptive-admission", action="store_true",
+                   help="--listen SLO-adaptive admission: a controller "
+                        "reads the declared --slo objectives' per-tick "
+                        "burn rate and retunes the live shed threshold "
+                        "and coalesce window with hysteresis "
+                        "(serving/adaptive.py; requires at least one "
+                        "--slo)")
     p.add_argument("--distmon", action="store_true",
                    help="distribution observability (--stream/--serve): "
                         "per-model score sketch updated at scatter-back "
@@ -186,7 +207,11 @@ def run(argv=None) -> dict:
     # Per-run telemetry: phase spans + registry snapshot in metrics.json
     # (plus --trace-out for Perfetto) — docs/OBSERVABILITY.md.
     telemetry.reset()
-    telemetry.enable(trace=bool(args.trace_out))
+    # Same contract as the training driver: trace sampling is on when
+    # anything consumes traces — --trace-out, or the live plane's
+    # /tracez (federation merges the tail per process).
+    telemetry.enable(trace=bool(args.trace_out)
+                     or args.obs_port is not None)
     # Live observability plane (docs/OBSERVABILITY.md §Live endpoints):
     # flight recorder armed for the whole run, HTTP endpoints when
     # --obs-port is given (a --serve process becomes scrapeable).
@@ -255,6 +280,25 @@ def _apply_legacy_aliases(summary: dict) -> dict:
 def _run_scoring(args, out_dir, logger, obs) -> dict:
     from photon_ml_tpu.data.paldb import load_feature_index_maps
 
+    # Flag contradictions fail BEFORE the model loads: a bad invocation
+    # should not pay (or need) a model-directory read to be diagnosed.
+    if args.listen is not None:
+        args.serve = True  # --listen IS the network serving shape
+    if args.stream and args.serve:
+        raise SystemExit("--stream and --serve are mutually exclusive: "
+                         "--stream is the bounded-memory bulk path, "
+                         "--serve the concurrent-request replay harness")
+    if args.adaptive_admission and args.listen is None:
+        raise SystemExit("--adaptive-admission retunes a live network "
+                         "front door; pass --listen")
+    if args.adaptive_admission and not args.slo:
+        raise SystemExit("--adaptive-admission steers on the declared "
+                         "--slo objectives; pass at least one --slo")
+    if args.distmon and not (args.stream or args.serve):
+        raise SystemExit("--distmon attaches score sketches to the "
+                         "streaming engine's scatter-back; pass "
+                         "--stream or --serve")
+
     model_dir = Path(args.game_model_input_dir)
     index_dir = Path(args.feature_index_dir) if args.feature_index_dir else \
         model_dir / "feature-indexes"
@@ -288,14 +332,6 @@ def _run_scoring(args, out_dir, logger, obs) -> dict:
         scores_dir.mkdir(exist_ok=True)
         scores_path = scores_dir / "part-00000.avro"
 
-    if args.stream and args.serve:
-        raise SystemExit("--stream and --serve are mutually exclusive: "
-                         "--stream is the bounded-memory bulk path, "
-                         "--serve the concurrent-request replay harness")
-    if args.distmon and not (args.stream or args.serve):
-        raise SystemExit("--distmon attaches score sketches to the "
-                         "streaming engine's scatter-back; pass "
-                         "--stream or --serve")
     # The model's embedded reference distributions (stamped by a
     # --stream-train --distmon run) — what serving drift-scores
     # against. None for models trained without --distmon.
@@ -472,6 +508,13 @@ def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
     score_mon = _attach_score_monitor(args, frontend.engine("default"),
                                       "default", reference, obs)
 
+    if args.listen is not None:
+        summary = _run_listen(args, frontend, logger, obs)
+        if score_mon is not None:
+            score_mon.publish_gauges()
+            summary["distributions"] = {"default": score_mon.snapshot()}
+        return summary
+
     with span("ingest"):
         requests = []
         for ds in iter_game_dataset_batches(
@@ -531,6 +574,84 @@ def _run_serve(args, inputs, id_types, shard_maps, model, evaluators,
         score_mon.publish_gauges()
         summary["distributions"] = {"default": score_mon.snapshot()}
     return summary
+
+
+def _parse_listen(addr: str):
+    """'PORT', ':PORT' or 'HOST:PORT' -> (host, port); SystemExit on
+    anything else (CLI validation, not a fault)."""
+    addr = addr.strip()
+    if ":" in addr:
+        host, _, port = addr.rpartition(":")
+        host = host or "127.0.0.1"
+    else:
+        host, port = "127.0.0.1", addr
+    try:
+        return host, int(port)
+    except ValueError:
+        raise SystemExit(f"bad --listen address {addr!r} "
+                         "(PORT, :PORT or HOST:PORT)") from None
+
+
+def _run_listen(args, frontend, logger, obs) -> dict:
+    """--listen: open the network front door over the front-end and
+    serve real sockets instead of replaying the input (which is ignored
+    — requests arrive over the wire). The bound port lands in
+    <output-dir>/net_port the moment the listener is up; the drain on
+    exit (--serve-seconds elapsed or SIGINT) lets every admitted
+    request settle and flush before the summary is written."""
+    import asyncio
+
+    from photon_ml_tpu.serving.adaptive import AdaptiveAdmission
+    from photon_ml_tpu.serving.netserver import NetServer, NetServerConfig
+
+    host, port = _parse_listen(args.listen)
+    out_dir = Path(args.output_dir)
+    report = {}
+
+    async def serve() -> None:
+        async with frontend:
+            server = await NetServer(
+                frontend, NetServerConfig(host=host, port=port)).start()
+            ctl = None
+            try:
+                if args.adaptive_admission:
+                    ctl = await AdaptiveAdmission(
+                        frontend, slo_specs=args.slo).start()
+                    obs.add_status_provider("adaptive_admission",
+                                            ctl.stats)
+                obs.add_status_provider("netserver", server.stats)
+                (out_dir / "net_port").write_text(str(server.port))
+                obs.mark_ready("serving")
+                logger.info(
+                    "serving on %s:%d (HTTP/1.1 + binary framing)%s",
+                    host, server.port,
+                    " with SLO-adaptive admission"
+                    if ctl is not None else "")
+                if args.serve_seconds is not None:
+                    await asyncio.sleep(args.serve_seconds)
+                else:
+                    while True:
+                        await asyncio.sleep(3600)
+            finally:
+                if ctl is not None:
+                    await ctl.stop()
+                    report["adaptive_admission"] = ctl.stats()
+                await server.close()
+                report["net"] = server.stats()
+
+    with span("serve"):
+        try:
+            asyncio.run(serve())
+        except KeyboardInterrupt:
+            logger.info("interrupted; network front door drained")
+    return {
+        "num_rows": 0,  # rows served are in frontend/engine stats
+        "metrics": {},
+        "scoring_path": "netserver",
+        "listen": f"{host}:{port}",
+        **report,
+        "frontend": frontend.stats(),
+    }
 
 
 def main() -> None:

@@ -322,7 +322,12 @@ def run(argv=None) -> dict:
     # spans land in metrics.json (and --trace-out); library code is
     # instrumented but silent outside a driver (docs/OBSERVABILITY.md).
     telemetry.reset()
-    telemetry.enable(trace=bool(args.trace_out))
+    # Trace sampling is on whenever something will consume traces: a
+    # --trace-out export, or the live plane (--obs-port serves /tracez
+    # and federation merges it — a plane whose trace tail is always
+    # empty breaks the fleet aggregator's per-process attribution).
+    telemetry.enable(trace=bool(args.trace_out)
+                     or args.obs_port is not None)
     # Live observability plane (docs/OBSERVABILITY.md §Live endpoints):
     # flight recorder armed for the whole run; with --obs-port a
     # multi-hour --stream-train becomes scrapeable, with a 1 Hz
@@ -915,12 +920,27 @@ def _stream_train(args, logger, task, fe_data, fe_opt, sequence,
                 name=name, feature_shard_id=shard, configs=grid)],
             num_iterations=args.num_iterations,
             validation_evaluators=evaluators)
+        # One trace context per λ-grid point, like the spill path
+        # below: the resident fit delegates the whole sweep to the
+        # estimator, so every grid point's trace spans the shared fit
+        # (the batched-sweep convention — G points, one clock). Without
+        # these the resident path's /tracez tail is empty for the whole
+        # run, which breaks the fleet aggregator's per-process trace
+        # attribution.
+        ctxs = [telemetry.mint("solve") for _ in grid]
+        for ctx, cfg in zip(ctxs, grid):
+            ctx.annotate(coordinate=name, mode="resident",
+                         reg_weight=cfg.regularization_weight,
+                         optimizer=str(cfg.optimizer_type),
+                         grid_points=len(grid))
         with span("solve"):
             results = estimator.fit(
                 data, validation_data=None,
                 checkpoint_dir=(Path(args.checkpoint_dir)
                                 if args.checkpoint_dir else None),
                 checkpoint_interval=args.checkpoint_interval)
+        for ctx in ctxs:
+            ctx.finish("ok")
         num_rows = data.num_rows
         stream_info = {
             "mode": "resident-assembled",

@@ -215,6 +215,14 @@ class DriverObservability:
                 pass
         if self.slo_tracker is not None:
             summary["slo"] = self.slo_tracker.evaluate()
+        if self.server is not None:
+            # Final-scrape handshake: if a fleet aggregator has been
+            # polling /snapshotz, hold the plane up (bounded) until one
+            # more full snapshot renders AFTER the refresh + SLO
+            # evaluation above — so the aggregator's last poll sees the
+            # settled end-of-run state (trace tail included) instead of
+            # racing stop(). A run nobody scraped returns immediately.
+            self.server.await_final_scrape(timeout_s=2.0)
         if self.server is not None or self.recorder is not None:
             summary["observability"] = {
                 "server": (self.server.summary()

@@ -26,6 +26,24 @@ _EXPORTS = {
     "RequestRejected": "photon_ml_tpu.serving.frontend",
     "UnknownModelError": "photon_ml_tpu.serving.frontend",
     "UnsupportedSubModelError": "photon_ml_tpu.serving.kernels",
+    # Network front door (netserver.py): dual-framing listener + client
+    # + typed wire errors over the front-end's admission path.
+    "NetServer": "photon_ml_tpu.serving.netserver",
+    "NetServerConfig": "photon_ml_tpu.serving.netserver",
+    "NetClient": "photon_ml_tpu.serving.netserver",
+    "WireError": "photon_ml_tpu.serving.netserver",
+    "MalformedFrame": "photon_ml_tpu.serving.netserver",
+    "FrameTooLarge": "photon_ml_tpu.serving.netserver",
+    "HeaderTimeout": "photon_ml_tpu.serving.netserver",
+    "ClientDisconnect": "photon_ml_tpu.serving.netserver",
+    "ServerError": "photon_ml_tpu.serving.netserver",
+    # SLO-adaptive admission (adaptive.py) + replica fleet router
+    # (router.py).
+    "AdaptiveAdmission": "photon_ml_tpu.serving.adaptive",
+    "AdaptiveAdmissionConfig": "photon_ml_tpu.serving.adaptive",
+    "WindowedBurn": "photon_ml_tpu.serving.adaptive",
+    "ReplicaRouter": "photon_ml_tpu.serving.router",
+    "RouterConfig": "photon_ml_tpu.serving.router",
 }
 
 __all__ = list(_EXPORTS)

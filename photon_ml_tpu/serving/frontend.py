@@ -200,10 +200,15 @@ class ServingFrontend:
                  config: Optional[FrontendConfig] = None,
                  tracing_guard: Optional[TracingGuard] = None,
                  pipeline_depth: int = 2):
-        self.config = config if config is not None else FrontendConfig()
         self.ladder = ladder if ladder is not None else BucketLadder()
         self.cache = ExecutableCache(guard=tracing_guard)
-        self.coalesce_window_s = float(self.config.coalesce_window_s)
+        # The config SETTER seeds the live actuator mirrors
+        # (coalesce_window_s, max_pending) — they are re-read on every
+        # cycle/admission so both the SLO-adaptive admission controller
+        # (serving/adaptive.py, which writes the mirrors directly) and
+        # an operator swapping ``fe.config`` whole retune a running
+        # front-end.
+        self.config = config if config is not None else FrontendConfig()
         self.max_group_rows = (self.config.max_group_rows
                                if self.config.max_group_rows is not None
                                else self.ladder.max_rows)
@@ -230,6 +235,20 @@ class ServingFrontend:
         self._closing = False
         for name, model in (models or {}).items():
             self.add_model(name, model)
+
+    @property
+    def config(self) -> FrontendConfig:
+        return self._config
+
+    @config.setter
+    def config(self, cfg: FrontendConfig) -> None:
+        # Re-seed the live actuator mirrors: swapping the (frozen)
+        # config on a running front-end must take effect on the next
+        # admission/cycle, exactly like the controller writing the
+        # mirrors directly.
+        self._config = cfg
+        self.coalesce_window_s = float(cfg.coalesce_window_s)
+        self.max_pending = int(cfg.max_pending)
 
     # -- model registry ----------------------------------------------------
 
@@ -351,13 +370,13 @@ class ServingFrontend:
             ctx.annotate(model=model)
             ctx.finish("error")
             raise UnknownModelError(model, self._engines)
-        if self._pending >= self.config.max_pending:
+        if self._pending >= self.max_pending:
             self._reject(model)
             ctx = trace if trace is not None else mint("request")
             ctx.annotate(model=model, scope="process")
             ctx.finish("shed")
             raise RequestRejected(model, self._pending,
-                                  self.config.max_pending,
+                                  self.max_pending,
                                   trace_id=ctx.trace_id)
         quota = self.config.max_pending_per_model
         model_pending = self._pending_by_model.get(model, 0)
@@ -708,7 +727,7 @@ class ServingFrontend:
             "models": list(self.models),
             **dict(self._stats),
             "pending": self._pending,
-            "max_pending": self.config.max_pending,
+            "max_pending": self.max_pending,
             "max_pending_per_model": self.config.max_pending_per_model,
             "pending_by_model": dict(sorted(
                 self._pending_by_model.items())),

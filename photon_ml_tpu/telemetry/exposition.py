@@ -295,6 +295,14 @@ class ObservabilityServer:
         self._ready_check: Optional[Callable[[], tuple]] = None
         self.scrapes = 0  # plain int: live even with telemetry disabled
         self._m_scrapes = _reg.registry().counter("observability.scrapes")
+        # /snapshotz scrape handshake (federation): the count increments
+        # AFTER the snapshot body is built, so a waiter that saw count k
+        # and wakes at k+1 knows one FULL snapshot was rendered after it
+        # started waiting — DriverObservability.finish() uses this to
+        # hold a short run's plane up until the aggregator's final poll
+        # has seen the settled end-of-run state.
+        self._snapshot_scrapes = 0
+        self._scrape_cv = threading.Condition()
         # A /statusz provider that raises is isolated (its error reports
         # inline) — but silent isolation hid broken providers for a
         # whole run. Count them (registry counter + always-live local
@@ -404,8 +412,14 @@ class ObservabilityServer:
             slo_specs=self.slo_specs,
             sketch_providers=self._sketch_providers,
             start_unix=self._start_unix)
-        return (json.dumps(snap, default=_json_default) + "\n",
-                "application/json")
+        body = json.dumps(snap, default=_json_default) + "\n"
+        # Bump-and-notify AFTER the body is built: a finish() waiter
+        # woken by this scrape is guaranteed the snapshot carries
+        # everything written before it started waiting.
+        with self._scrape_cv:
+            self._snapshot_scrapes += 1
+            self._scrape_cv.notify_all()
+        return (body, "application/json")
 
     def _statusz(self, accept: str = ""):
         self._run_scrape_hooks()
@@ -502,6 +516,28 @@ class ObservabilityServer:
         ``sketch_from_state``). Federation merges equal keys across
         peers with the sketches' deterministic merges."""
         self._sketch_providers[name] = fn
+
+    def await_final_scrape(self, timeout_s: float = 2.0) -> bool:
+        """Final-scrape handshake: block until one more FULL /snapshotz
+        render completes, or ``timeout_s`` elapses. Returns immediately
+        (False) when no federation scraper ever polled this server —
+        zero snapshotz scrapes means nobody is watching and a plain run
+        must not pay an exit delay. Used by the drivers' finish() so a
+        short run cannot tear the plane down between an aggregator's
+        last poll and the settled end-of-run counters (trace tail, final
+        gauge refresh) — the scrape race tests/test_observability_plane
+        used to hit."""
+        with self._scrape_cv:
+            seen = self._snapshot_scrapes
+            if seen == 0:
+                return False
+            deadline = time.monotonic() + timeout_s
+            while self._snapshot_scrapes <= seen:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._scrape_cv.wait(remain)
+            return True
 
     def add_route(self, path: str, fn) -> None:
         """Install or override a route. ``fn(accept)`` returns

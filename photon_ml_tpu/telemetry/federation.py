@@ -114,6 +114,15 @@ GAUGE_MERGE_POLICIES: Dict[str, str] = {
     # than summing axis extents into a meaningless total. (The
     # training.mesh.*_transfer_bytes series are counters and sum.)
     "training.mesh.": "last",
+    # Network front door (serving/netserver.py): connections held open
+    # are per-process holdings — the fleet has the sum. (Everything
+    # else under serving.net.* is a counter; lint rule counter-family.)
+    "serving.net.open_connections": "sum",
+    # SLO-adaptive admission controller state (serving/adaptive.py):
+    # each replica steers its own knobs; the merged view keeps the
+    # newest writer (burn_rate maxes via the .burn_rate entry above —
+    # the fleet is as burnt as its worst member).
+    "serving.adaptive.": "last",
 }
 
 _VALID_POLICIES = ("sum", "max", "last")

@@ -72,6 +72,13 @@ def pytest_configure(config):
         "(photon_ml_tpu/native/_avro_native.c) and is skipped cleanly "
         "when the extension is unbuilt (no C compiler) or disabled via "
         "PHOTON_ML_TPU_NO_NATIVE=1")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight test — forced-device subprocess suites "
+        "(full jax-init training-driver children) and the longest "
+        "solver-parity sweeps whose cheaper siblings keep the "
+        "coverage; excluded from the tier-1 `-m 'not slow'` budget "
+        "run, still runs in full CI (ROADMAP.md §verify)")
 
 
 def _native_decoder_available() -> bool:

@@ -51,6 +51,7 @@ def test_checkpoint_retention_and_atomicity(tmp_path):
     assert latest_checkpoint(tmp_path).name == "ckpt-00000004.pkl"
 
 
+@pytest.mark.slow
 def test_resume_matches_uninterrupted_run(rng, tmp_path):
     """Kill after a mid-descent checkpoint; the resumed run must reproduce
     the uninterrupted run (fold_in per-step keys make this exact)."""
@@ -185,6 +186,7 @@ def test_legacy_string_tag_still_resumes(rng, tmp_path):
             checkpoint_tag=tag_map)  # must NOT raise
 
 
+@pytest.mark.slow
 def test_resume_preserves_best_model_and_validation(rng, tmp_path):
     data, *_ = make_glmix_data(rng, n=300)
     vdata, *_ = make_glmix_data(rng, n=120)

@@ -383,6 +383,116 @@ def test_game_scoring_serve_matches_batch(tmp_path, rng):
         ])
 
 
+def test_game_scoring_listen_network_front_door(tmp_path, rng):
+    """--listen opens the framed network front door over the serving
+    front-end: requests over BOTH framings (length-prefixed binary and
+    HTTP/1.1 JSON) score byte-identically to each other, reproduce the
+    one-shot batch run, and the summary carries the netserver report.
+    The driver runs in a thread (it owns its own event loop); the test
+    is the network client."""
+    import asyncio
+    import threading
+    import time
+
+    from photon_ml_tpu.data.avro_reader import iter_game_dataset_batches
+    from photon_ml_tpu.data.paldb import load_feature_index_maps
+    from photon_ml_tpu.serving.netserver import NetClient
+
+    model_dir, valid = _train_small_game(tmp_path, rng, n_train=200,
+                                         n_valid=40)
+    batch_out = tmp_path / "score-batch"
+    batch = game_scoring_driver.run([
+        "--input-dirs", str(valid),
+        "--game-model-input-dir", str(model_dir),
+        "--output-dir", str(batch_out),
+    ])
+    assert batch["numRows"] == 40
+    want = [r["predictionScore"] for r in
+            read_container(batch_out / "scores" / "part-00000.avro")]
+
+    # Build the wire requests the way the driver's serve replay does:
+    # featureized batches split into fixed-row requests.
+    shard_maps = load_feature_index_maps(model_dir / "feature-indexes")
+    requests = []
+    for ds in iter_game_dataset_batches(
+            [valid], id_types=["userId"], feature_shard_maps=shard_maps,
+            batch_rows=64, feeder="python"):
+        for a in range(0, ds.num_rows, 8):
+            requests.append(ds.subset(
+                np.arange(a, min(a + 8, ds.num_rows))))
+    assert len(requests) == 5
+
+    listen_out = tmp_path / "score-listen"
+    result = {}
+
+    def drive():
+        result["summary"] = game_scoring_driver.run([
+            "--input-dirs", str(valid),
+            "--game-model-input-dir", str(model_dir),
+            "--output-dir", str(listen_out),
+            "--listen", "127.0.0.1:0", "--serve-seconds", "8",
+            "--coalesce-ms", "1",
+        ])
+
+    t = threading.Thread(target=drive)
+    t.start()
+    try:
+        port_file = listen_out / "net_port"
+        deadline = time.time() + 60
+        while not port_file.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert port_file.exists(), "--listen never published net_port"
+        port = int(port_file.read_text())
+
+        async def client():
+            async with NetClient("127.0.0.1", port) as c:
+                got_b = [await c.score(r) for r in requests]
+            async with NetClient("127.0.0.1", port,
+                                 framing="http") as c:
+                got_h = [await c.score(r) for r in requests]
+            return got_b, got_h
+
+        got_b, got_h = asyncio.run(client())
+    finally:
+        t.join(timeout=60)
+    assert not t.is_alive()
+
+    bin_scores = np.concatenate(got_b)
+    # The two framings return the SAME BYTES (JSON float repr
+    # round-trips doubles exactly).
+    assert bin_scores.tobytes() == np.concatenate(got_h).tobytes()
+    offsets = np.concatenate([np.asarray(r.offsets) for r in requests])
+    np.testing.assert_allclose(bin_scores + offsets, want,
+                               rtol=1e-9, atol=1e-9)
+
+    summary = result["summary"]
+    assert summary["scoring_path"] == "netserver"
+    assert summary["listen"] == "127.0.0.1:0"
+    net = summary["net"]
+    assert net["requests_binary"] == 5 and net["requests_http"] == 5
+    assert net["responses"] == 10 and net["wire_errors"] == {}
+    fe = summary["frontend"]
+    assert fe["admitted"] == fe["completed"] == 10
+    assert fe["rejected"] == 0
+
+
+def test_game_scoring_listen_flag_validation(tmp_path):
+    with pytest.raises(SystemExit, match="pass --listen"):
+        game_scoring_driver.run([
+            "--input-dirs", str(tmp_path),
+            "--game-model-input-dir", str(tmp_path),
+            "--output-dir", str(tmp_path / "out"),
+            "--adaptive-admission",
+        ])
+    with pytest.raises(SystemExit, match="at least one --slo"):
+        game_scoring_driver.run([
+            "--input-dirs", str(tmp_path),
+            "--game-model-input-dir", str(tmp_path),
+            "--output-dir", str(tmp_path / "out"),
+            "--listen", ":0", "--adaptive-admission",
+        ])
+
+
 def test_game_training_grid_selects_best(tmp_path, rng):
     train = tmp_path / "train"
     valid = tmp_path / "valid"
@@ -853,6 +963,7 @@ def _latent_records(out_dir):
             list(read_container(base / "projection-latent-factors.avro")))
 
 
+@pytest.mark.slow
 def test_stream_train_mf_identity_across_residency_and_feeder(tmp_path,
                                                               rng):
     """Tentpole acceptance at the CLI: a factor table larger than
@@ -902,6 +1013,7 @@ def test_stream_train_mf_identity_across_residency_and_feeder(tmp_path,
     assert [r["effectId"] for r in g_res] == [r["effectId"] for r in g_ic]
 
 
+@pytest.mark.slow
 def test_stream_train_mf_bf16_and_redecode_tiers(tmp_path, rng):
     """Spill tiers for factors at the CLI: bf16 models are bitwise
     residency-independent and parity-bounded vs f32; redecode keeps

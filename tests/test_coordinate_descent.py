@@ -4,6 +4,7 @@ the analog of the reference's CoordinateDescentTest + GameEstimatorTest
 """
 
 import numpy as np
+import pytest
 import scipy.sparse as sp
 
 from photon_ml_tpu.algorithm import (
@@ -144,6 +145,7 @@ def test_warm_start_resumes(rng):
     assert res2.objective_history[-1] <= res1.objective_history[-1] + 1e-6
 
 
+@pytest.mark.slow
 def test_cd_objective_invariant_across_mesh_sizes(rng):
     """Sharding invariance — the BASELINE north-star's chip-scaling
     property testable without a pod: the SAME GLMix descent on 1/2/4/8

@@ -200,6 +200,7 @@ def test_render_html_report_smoke():
     assert not DiagnosticMode("VALIDATE").train_enabled
 
 
+@pytest.mark.slow
 def test_glm_driver_diagnostic_mode(tmp_path, rng):
     from tests.test_cli_drivers import _write_glm_avro
     from photon_ml_tpu.cli.glm_driver import run

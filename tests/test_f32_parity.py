@@ -155,6 +155,7 @@ def _glmix_cd(rng, dtype, n=2000, d=12, n_users=40):
 
 
 @needs_f64
+@pytest.mark.slow
 def test_coordinate_descent_f32_matches_f64(rng):
     """Full GLMix coordinate descent: the f32 objective trajectory must
     track f64 at ~1e-4 relative per update, and both must be monotone

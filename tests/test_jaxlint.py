@@ -743,6 +743,26 @@ async def poll():
     assert analyze_sources({"photon_ml_tpu/data/f.py": src}) == []
 
 
+def test_blocking_in_async_covers_net_cli_modules():
+    """The network front door grew event loops outside serving/: the
+    router CLI and the scoring driver's --listen mode are covered
+    file-wise (rule _FILES), while other cli/ modules stay exempt."""
+    src = '''
+import time
+
+
+async def poll():
+    time.sleep(0.01)
+'''
+    for covered in ("photon_ml_tpu/cli/net_router.py",
+                    "photon_ml_tpu/cli/game_scoring_driver.py"):
+        assert rules_of(analyze_sources(
+            {covered: src})) == ["blocking-in-async"], covered
+    # a different cli module (no event loop of its own) is not scoped
+    assert analyze_sources(
+        {"photon_ml_tpu/cli/game_training_driver.py": src}) == []
+
+
 # -- the actual tree is clean ----------------------------------------------
 
 def test_repo_tree_is_jaxlint_clean(capsys):

@@ -83,6 +83,7 @@ def _vmapped(obj, cfg, x, y, off, w, coef0, factors=None, shifts=None,
     ("owlqn", OptimizerType.LBFGS, 0.3),
     ("tron", OptimizerType.TRON, 0.0),
 ])
+@pytest.mark.slow
 def test_kernel_normalization_matches_vmapped(rng, mode, opt, l1):
     dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
     e, r, d = 29, 6, 5
@@ -234,6 +235,7 @@ def test_gathered_transforms_round_trip(rng):
     np.testing.assert_allclose(np.asarray(back), coef, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_re_coordinate_normalized_kernel_matches_fallback(monkeypatch, rng):
     """End-to-end: a normalized + bounded RandomEffectCoordinate update
     routes through the kernel (interpret mode) and matches the NO_PALLAS

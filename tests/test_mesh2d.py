@@ -209,6 +209,7 @@ def test_mesh_grid_2d_shapes():
 # -- the bitwise gate across mesh shapes -----------------------------------
 
 
+@pytest.mark.slow
 def test_2d_value_grad_hvp_bitwise_across_shapes(problem, rng):
     """Acceptance: every fold quantity is bit-identical for mesh shapes
     {1x1, 2x1, 1x2, 2x2} and equal to the non-mesh fold."""
@@ -262,6 +263,7 @@ def test_2d_normalized_passes(problem, rng):
                     rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_2d_solves_bitwise_across_shapes(problem):
     """Full streamed L-BFGS and TRON solves are bit-identical across
     mesh shapes (plain and factors-only normalization)."""
@@ -303,6 +305,7 @@ def test_2d_residency_independence(problem, rng):
         assert _bits(g) == _bits(g_ref)
 
 
+@pytest.mark.slow
 def test_2d_trace_budgets(problem, rng):
     """Compile counts stay within the per-coordinate budgets for 2-D
     shapes, and adding data-axis devices never buys a column kernel
@@ -506,10 +509,13 @@ def test_mesh_shape_flag_validation(tmp_path, rng):
                     "--mesh-shape", "2"])
 
 
+@pytest.mark.slow
 def test_mesh_shape_driver_model_identical(tmp_path, rng):
     """In-process driver gate: --mesh-shape {1x1, 2x1, 1x2, 2x2} all
     write the non-mesh spill model bit for bit, and --mesh-devices N
-    stays the back-compat alias of Nx1."""
+    stays the back-compat alias of Nx1. Slow-marked: six full driver
+    training runs (tier-1 keeps the flag-validation and bitwise mesh
+    coverage above; full CI runs this end-to-end gate)."""
     from photon_ml_tpu.cli import game_training_driver
     from tests.test_cli_drivers import (
         _STREAM_BASE,
@@ -582,12 +588,15 @@ _G4_CFG = ("fixed:25,1e-7,0.5,1.0,LBFGS,L2|25,1e-7,1.0,1.0,LBFGS,L2"
            "|25,1e-7,5.0,1.0,LBFGS,L2|25,1e-7,50.0,1.0,LBFGS,L2")
 
 
+@pytest.mark.slow
 def test_driver_grid_batched_2d_mesh_model_bytes(tmp_path, rng,
                                                  multi_device):
     """--grid-batched x 2-D mesh on the REAL device-count axis:
     children whose jax sees exactly R*C devices run mesh shapes
     {1x1, 2x2} for grids G in {1, 4}; within each G the decoded model
-    bytes must not depend on the mesh shape."""
+    bytes must not depend on the mesh shape. Slow-marked: four
+    forced-device subprocess training runs (grid x mesh bitwise parity
+    stays covered in-process by test_2d_grid_passes_bitwise)."""
     from tests.test_cli_drivers import _write_sparse_fe_avro
 
     train = tmp_path / "train"

@@ -135,6 +135,7 @@ def test_mesh_residency_independence(problem, rng):
                    for b in stats["per_device_bytes"])
 
 
+@pytest.mark.slow
 def test_mesh_streaming_solvers_bitwise_across_mesh_sizes(problem):
     """Full streamed L-BFGS and TRON solves write the same coefficient
     bits for mesh sizes {1, 2, 4} (spill-forced) as without a mesh."""
@@ -218,6 +219,7 @@ def test_mesh_per_device_budget_and_placement(problem):
     assert all(b <= block for b in stats["per_device_bytes"])
 
 
+@pytest.mark.slow
 def test_mesh_trace_budgets_per_bucket_not_per_device(problem):
     """Every per-device kernel is registered in the guard and stays
     within its per-BUCKET budget across a λ-grid sweep + TRON — and no
@@ -401,6 +403,7 @@ print("MESH_CHILD_OK", n_devices)
 """
 
 
+@pytest.mark.slow
 def test_driver_mesh_model_bytes_independent_of_total_device_count(
         tmp_path, rng, multi_device):
     """End-to-end on the REAL device-count axis: the spill-mode driver
@@ -409,7 +412,9 @@ def test_driver_mesh_model_bytes_independent_of_total_device_count(
     however many chips it has), with --mesh-devices N — the decoded
     coefficient records must be identical across N (the container
     header embeds a random sync marker, so decoded records are the
-    byte-identity comparison unit)."""
+    byte-identity comparison unit). Slow-marked: three forced-device
+    subprocess training runs (the in-process bitwise mesh-size parity
+    stays in tier-1 via test_mesh_streaming_solvers_bitwise_...)."""
     from tests.test_cli_drivers import _write_sparse_fe_avro
 
     train = tmp_path / "train"

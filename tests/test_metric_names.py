@@ -247,3 +247,37 @@ def test_gauge_policy_rule_wired_through_main(tmp_path):
     assert metric_names.main(["--root", str(tmp_path)]) == 1
     mod.write_text(TELEM + 'gauge("covered.bytes")\n')
     assert metric_names.main(["--root", str(tmp_path)]) == 0
+
+
+# -- serving.net.* counter family (PR: network front door) -----------------
+
+def test_counter_family_serving_net(tmp_path):
+    # TP: gauges (outside the allowlist) and histograms under the
+    # serving.net. prefix break the wire-event family — dashboards
+    # rate() the whole namespace
+    for bad in ('gauge("serving.net.bytes_read")',
+                'histogram("serving.net.request_latency_seconds")',
+                'histogram("serving.net.frame_bytes")'):
+        out = violations(tmp_path, TELEM + bad + "\n")
+        assert any(rule == "counter-family" for rule, _ in out), bad
+    # the f-string form is caught too (prefix-anchored on the leading
+    # fragment, like the gauge-only prefix families)
+    out = violations(tmp_path,
+                     TELEM + 'gauge(f"serving.net.peer.{pid}.lag")\n')
+    assert any(rule == "counter-family" for rule, _ in out)
+
+
+def test_counter_family_fp_guards(tmp_path):
+    # counters throughout the family are the contract; the allowlisted
+    # open_connections gauge is the one sanctioned instantaneous
+    # reading; neighboring namespaces keep their kinds; a name merely
+    # CONTAINING the prefix mid-name is a different namespace
+    ok = (TELEM +
+          'counter("serving.net.wire_errors")\n'
+          'counter(f"serving.net.errors.{kind}")\n'
+          'counter("serving.net.bytes_written")\n'
+          'gauge("serving.net.open_connections")\n'
+          'gauge("serving.adaptive.burn_rate")\n'
+          'histogram("serving.frontend.request_latency_seconds")\n'
+          'counter(f"{ns}.serving.net.shadow")\n')
+    assert violations(tmp_path, ok) == []

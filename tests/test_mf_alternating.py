@@ -136,6 +136,7 @@ def test_streamed_parity_bounded_vs_in_core(rng):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_model_bytes_identical_across_residency_and_batching(rng):
     """The tentpole acceptance: a factor table larger than the budget
     trains out-of-core with model bytes IDENTICAL to the fully
@@ -276,6 +277,7 @@ def test_zero_observation_entities_solve_to_zero(rng):
         assert np.all(g[slot] == 0.0)
 
 
+@pytest.mark.slow
 def test_entity_counts_straddling_bucket_boundaries(rng):
     """Entity populations at/over the pow-2 pad and shard-split
     boundaries train and keep byte-identity across residency."""

@@ -426,6 +426,10 @@ def test_fleet_aggregator_over_live_training_run(tmp_path, rng):
     # Aggregator readiness: >= 1 fresh peer while the run was live.
     assert results["ready_code"] == 200
     statusz = json.loads(results["statusz"])
-    (peer_meta,) = statusz["peer_processes"].values()
+    # peer_processes carries the aggregator's own fleet.* pseudo-peer
+    # alongside the scraped peers (federation.py SELF_PEER_ID) — the
+    # driver must be the only REAL peer.
+    (peer_meta,) = (v for v in statusz["peer_processes"].values()
+                    if v["role"] != "aggregator")
     assert peer_meta["role"] == "training"
     assert peer_meta["pid"] > 0

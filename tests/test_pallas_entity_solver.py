@@ -245,6 +245,7 @@ def test_pallas_solver_edge_shapes(rng, e, r, d):
                                rtol=gold(1e-7, f32_floor=1e-4))
 
 
+@pytest.mark.slow
 def test_pallas_owlqn_matches_vmapped(rng):
     """Elastic-net (OWL-QN) kernel mode vs the vmapped minimize_owlqn
     path through solve_glm — values, coefficients, and the SPARSITY
@@ -285,6 +286,7 @@ def test_pallas_owlqn_matches_vmapped(rng):
     assert np.array_equal(zk, zv)
 
 
+@pytest.mark.slow
 def test_solve_block_routes_elastic_net_through_kernel(monkeypatch, rng):
     """_solve_block routes ELASTIC_NET configs to the kernel's OWL-QN
     mode (previously an automatic fallback to the vmapped path)."""
@@ -360,6 +362,7 @@ def test_pallas_tron_matches_vmapped(rng, task):
                                atol=gold(1e-4, f32_floor=1e-2))
 
 
+@pytest.mark.slow
 def test_solve_block_routes_tron_through_kernel(monkeypatch, rng):
     """TRON random-effect configs reach the kernel; once-differentiable
     losses keep the vmapped fallback (which raises solve_glm's error)."""
@@ -405,6 +408,7 @@ def test_solve_block_routes_tron_through_kernel(monkeypatch, rng):
 
 
 @pytest.mark.parametrize("mode", ["tron", "owlqn"])
+@pytest.mark.slow
 def test_pallas_solver_overflow_trials_stay_finite(rng, mode):
     """Rejected trial steps whose margins overflow exp must not poison
     the retained iterate (the arithmetic keep-old select computes
